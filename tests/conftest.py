@@ -1,0 +1,33 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so every
+multi-chip code path (shard_map, psum over ICI) runs in CI without TPU
+hardware — the analogue of the reference-style 'test multi-node without a
+cluster' strategy (SURVEY.md §4.5)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from kafka_assignment_optimizer_tpu.models.cluster import (  # noqa: E402
+    demo_assignment,
+    demo_broker_list,
+    demo_topology,
+)
+
+
+@pytest.fixture
+def demo():
+    """The reference's worked demo (README.md:27-63): golden test #1."""
+    return demo_assignment(), demo_broker_list(), demo_topology()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

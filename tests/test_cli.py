@@ -1,0 +1,46 @@
+"""CLI end-to-end: demo JSON on stdin -> optimal plan on stdout
+(the reference's batch UX, README.md:35-48)."""
+
+import json
+import subprocess
+import sys
+
+from kafka_assignment_optimizer_tpu.models.cluster import demo_assignment
+
+
+def run_cli(args, stdin_text):
+    return subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu", *args],
+        input=stdin_text,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd="/root/repo",
+    )
+
+
+def test_cli_demo_golden(tmp_path):
+    proc = run_cli(
+        [
+            "--broker-list", "0-18",
+            "--topology", "even-odd",
+            "--solver", "milp",
+            "--report",
+            "--emit-lp", str(tmp_path / "model.lp"),
+        ],
+        demo_assignment().to_json(),
+    )
+    assert proc.returncode == 0, proc.stderr
+    plan = json.loads(proc.stdout)
+    by_part = {p["partition"]: p["replicas"] for p in plan["partitions"]}
+    assert by_part[1][0] == 8 and 19 not in by_part[1]
+    report = json.loads(proc.stderr)
+    assert report["replica_moves"] == 1
+    assert report["feasible"] is True
+    lp_text = (tmp_path / "model.lp").read_text()
+    assert lp_text.startswith("// Optimization function")
+
+
+def test_cli_infeasible_inputs_error():
+    proc = run_cli(["--broker-list", "0"], demo_assignment().to_json())
+    assert proc.returncode != 0

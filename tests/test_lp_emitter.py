@@ -1,0 +1,96 @@
+"""Emitter parity tests (SURVEY.md §4.2): regenerate the reference LP
+sample's structure and assert section order, row counts (SURVEY.md §3.3),
+variable naming, and bound arithmetic (README.md:144-185)."""
+
+import re
+
+import numpy as np
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.solvers.lp import emit_lp, var_name
+
+
+def test_var_naming_matches_reference(demo):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    # README.md:146 style: t{topicIdx}b{brokerId}p{partitionId}[_l]
+    b9 = int(np.searchsorted(inst.broker_ids, 9))
+    assert var_name(inst, 6, b9, False) == "t1b9p6"
+    assert var_name(inst, 6, b9, True) == "t1b9p6_l"
+
+
+def test_lp_text_structure(demo):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    text = emit_lp(inst)
+    P, B, K = inst.num_parts, inst.num_brokers, inst.num_racks
+
+    # section headers present, in the reference order (README.md:144-185)
+    headers = [ln for ln in text.splitlines() if ln.startswith("//")]
+    assert headers == [
+        "// Optimization function, based on current assignment ",
+        "// Constrain on replication factor for every partition",
+        "// Constraint on having one and only one leader per partition",
+        "// Constraint on min/max replicas per broker",
+        "// Constraint on min/max leaders per broker",
+        "// Constraint on no leader and replicas on the same broker",
+        "// Constrain on min/max total replicas per racks",
+        "// Constrain on min/max replicas per partitions per racks",
+        "// All variables are binary",
+    ]
+
+    # row counts per SURVEY.md §3.3: P + P + 2B + 2B + BP + 2K + PK
+    rows = [ln for ln in text.splitlines() if ln.endswith(";") and "max:" not in ln
+            and not ln.startswith("t1b1p0,")]
+    n_constraints = len([r for r in rows if ("<=" in r or ">=" in r or "=" in r)])
+    assert n_constraints == P + P + 2 * B + 2 * B + B * P + 2 * K + P * K
+
+    # objective line: starts max:, weights drawn from the observed tiers
+    obj = next(ln for ln in text.splitlines() if ln.startswith("max:"))
+    coeffs = set(re.findall(r"(\d) t1b\d+p\d+", obj))
+    assert coeffs <= {"1", "2", "4"}
+    assert "4 " in obj  # leader-keep tier present
+
+    # bin block declares the full cross product: 2*B*P names
+    bin_idx = text.splitlines().index("bin")
+    bin_line = text.splitlines()[bin_idx + 1]
+    assert bin_line.count(",") + 1 == 2 * B * P
+    assert bin_line.endswith(";")
+
+
+def test_lp_bounds_in_rows(demo):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    text = emit_lp(inst)
+    lines = text.splitlines()
+    # broker band rows: <= 2 then >= 1 (20 replicas / 19 brokers, README.md:158-161)
+    start = lines.index("// Constraint on min/max replicas per broker")
+    assert lines[start + 1].endswith("<= 2;")
+    assert lines[start + 2].endswith(">= 1;")
+    # leader band rows: <= 1 then >= 0 (README.md:163-166)
+    start = lines.index("// Constraint on min/max leaders per broker")
+    assert lines[start + 1].endswith("<= 1;")
+    assert lines[start + 2].endswith(">= 0;")
+    # uniqueness rows: pairs x + y <= 1 (README.md:168-171)
+    start = lines.index("// Constraint on no leader and replicas on the same broker")
+    assert re.fullmatch(r"t1b0p0 \+ t1b0p0_l <= 1;", lines[start + 1])
+
+
+def test_lp_parse_round_trip(demo):
+    # feed a synthetic lp_solve -S4 listing through the parser
+    from kafka_assignment_optimizer_tpu.solvers.lp import parse_lp_solve_output
+    from kafka_assignment_optimizer_tpu.solvers.milp import solve_milp
+
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    res = solve_milp(inst)
+    lines = ["Value of objective function: whatever", ""]
+    for p in range(inst.num_parts):
+        for s in range(int(inst.rf[p])):
+            b = int(res.a[p, s])
+            lines.append(f"{var_name(inst, p, b, s == 0)}   1")
+    # zeros listed too, as lp_solve does
+    lines.append("t1b0p0    0")
+    a = parse_lp_solve_output(inst, "\n".join(lines))
+    np.testing.assert_array_equal(np.sort(a, 1), np.sort(res.a, 1))
+    assert (a[:, 0] == res.a[:, 0]).all()

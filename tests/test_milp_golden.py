@@ -1,0 +1,100 @@
+"""Golden end-to-end tests (SURVEY.md §4.1): the reference's worked demo
+must solve to exactly one replica move — partition 1 ``[8,19] -> [8,1]``
+or a same-cost AZ-balanced symmetric answer (README.md:83-91)."""
+
+import numpy as np
+
+from kafka_assignment_optimizer_tpu import build_instance, move_diff, optimize
+from kafka_assignment_optimizer_tpu.solvers.milp import build_milp
+
+
+def test_demo_golden_one_move(demo):
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="milp")
+    rep = res.report()
+    assert rep["feasible"], rep
+    assert res.replica_moves == 1, res.assignment.to_json(indent=1)
+    # only partition 1 (which held removed broker 19) changes replicas
+    changed = {k.partition for k in res.moves.changed}
+    assert 1 in changed
+    p1 = res.assignment.by_key()[[k for k in res.assignment.by_key()
+                                  if k.partition == 1][0]]
+    assert p1.leader == 8  # leader preserved
+    assert 19 not in p1.replicas
+    # replacement broker keeps AZ balance: 19 was odd/AZ b -> new is odd
+    new_b = [b for b in p1.replicas if b != 8][0]
+    assert new_b % 2 == 1
+    assert res.solve.optimal
+
+
+def test_demo_objective_is_max_minus_follower_loss(demo):
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="milp")
+    inst = res.instance
+    # optimum keeps everything except one follower slot of partition 1
+    # whose broker (19) was removed and carries no weight
+    assert res.solve.objective == inst.preservation_weight(res.solve.a)
+    assert res.solve.objective == inst.max_weight()
+
+
+def test_milp_row_counts_match_reference_structure(demo):
+    # SURVEY.md §3.3: P + P + B + B + B*P + K + P*K constraint rows
+    # (bands are interval constraints = one row each here, two in LP text)
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    _, constraint, integrality = build_milp(inst)
+    P, B, K = inst.num_parts, inst.num_brokers, inst.num_racks
+    assert constraint.A.shape[0] == P + P + B + B + B * P + K + P * K
+    assert constraint.A.shape[1] == 2 * B * P == len(integrality)
+
+
+def test_no_change_needed_is_zero_moves(demo):
+    current, _, topo = demo
+    # keep all 20 brokers: current assignment is already optimal
+    res = optimize(current, list(range(20)), topo, solver="milp")
+    assert res.replica_moves == 0
+    assert res.moves.leader_changes == 0
+    assert res.assignment.to_dict() == current.to_dict()
+
+
+def test_scale_out_rebalance_small():
+    # add brokers to a loaded cluster; plan must be feasible and move few
+    import itertools
+
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        Assignment,
+        PartitionAssignment,
+        Topology,
+    )
+
+    rng = np.random.default_rng(7)
+    B0, P = 6, 12
+    parts = []
+    cycle = itertools.cycle(range(B0))
+    for p in range(P):
+        a = next(cycle)
+        b = (a + 1) % B0
+        parts.append(PartitionAssignment("t", p, [a, b]))
+    current = Assignment(partitions=parts)
+    topo = Topology.even_odd(range(8))
+    res = optimize(current, list(range(8)), topo, solver="milp")
+    rep = res.report()
+    assert rep["feasible"], rep
+    # 24 replicas over 8 brokers -> exactly 3 each; moving >8 replicas is
+    # never needed to rebalance 2 new brokers to band
+    assert res.replica_moves <= 8
+
+
+def test_rf_increase_adds_replicas_without_moving_existing(demo):
+    current, _, topo = demo
+    res = optimize(current, list(range(20)), topo, target_rf=3, solver="milp")
+    rep = res.report()
+    assert rep["feasible"], rep
+    old = current.by_key()
+    for key, p in res.assignment.by_key().items():
+        assert len(p.replicas) == 3
+        # existing replicas kept (optimal: only additions)
+        assert set(old[key].replicas) <= set(p.replicas)
+        assert p.leader == old[key].leader
+    # 10 new replicas = 10 "moves" (data copies), the unavoidable minimum
+    assert res.replica_moves == 10

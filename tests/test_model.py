@@ -1,0 +1,161 @@
+"""Model-layer tests: ingest/emit round trips, move diff, bound arithmetic
+(SURVEY.md §2 rules), weight rule (README.md:146 data points)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import (
+    Assignment,
+    Topology,
+    build_instance,
+    move_diff,
+    parse_broker_list,
+)
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    demo_assignment,
+    demo_broker_list,
+    demo_topology,
+)
+from kafka_assignment_optimizer_tpu.models.instance import (
+    W_FOLLOWER_KEEP,
+    W_FOLLOWER_PROMOTE,
+    W_LEADER_DEMOTE,
+    W_LEADER_KEEP,
+)
+
+
+def test_json_round_trip():
+    a = demo_assignment()
+    b = Assignment.from_json(a.to_json())
+    assert b.to_dict() == a.to_dict()
+    assert b.partitions[1].replicas == [8, 19]
+    assert b.partitions[1].leader == 8
+
+
+def test_parse_broker_list():
+    assert parse_broker_list("0,1,2") == [0, 1, 2]
+    assert parse_broker_list("0-3,7") == [0, 1, 2, 3, 7]
+    assert parse_broker_list("1,1,2") == [1, 2]
+
+
+def test_topology_forms():
+    t1 = Topology.from_dict({"0": "a", "1": "b"})
+    t2 = Topology.from_dict({"racks": {"a": [0], "b": [1]}})
+    assert t1.to_dict() == t2.to_dict()
+    demo = demo_topology()
+    assert demo.rack(19) == "b" and demo.rack(18) == "a"
+    assert demo.racks() == ["a", "b"]
+
+
+def test_move_diff_counts_replica_moves():
+    old = demo_assignment()
+    new = Assignment.from_dict(old.to_dict())
+    new.by_key()  # no-op
+    # the demo's known-optimal single edit: partition 1 [8,19] -> [8,1]
+    for p in new.partitions:
+        if p.partition == 1:
+            p.replicas = [8, 1]
+    d = move_diff(old, new)
+    assert d.replica_moves == 1
+    assert d.leader_changes == 0
+    assert [k.partition for k in d.changed] == [1]
+
+
+def test_move_diff_leader_only():
+    old = demo_assignment()
+    new = Assignment.from_dict(old.to_dict())
+    for p in new.partitions:
+        if p.partition == 0:
+            p.replicas = [18, 7]  # swap leader, same replica set
+    d = move_diff(old, new)
+    assert d.replica_moves == 0
+    assert d.leader_changes == 1
+
+
+def test_instance_shapes_and_bounds_demo():
+    inst = build_instance(demo_assignment(), demo_broker_list(), demo_topology())
+    # demo: 19 eligible brokers, 10 partitions, RF 2, 2 racks
+    assert inst.num_brokers == 19
+    assert inst.num_parts == 10
+    assert inst.num_racks == 2
+    assert inst.max_rf == 2
+    assert inst.total_replicas == 20
+    # README.md:158-161 -> replicas/broker in [1, 2] (20 replicas / 19 brokers)
+    assert (inst.broker_lo, inst.broker_hi) == (1, 2)
+    # README.md:163-166 -> leaders/broker in [0, 1]
+    assert (inst.leader_lo, inst.leader_hi) == (0, 1)
+    # rack sizes: even 'a' has 10 brokers (0..18 even), odd 'b' has 9
+    np.testing.assert_array_equal(
+        np.sort(np.bincount(inst.rack_of_broker[:19])), [9, 10]
+    )
+    # proportional bounds: a: 20*10/19 in [10, 11]; b: 20*9/19 in [9, 10]
+    a_idx = inst.rack_names.index("a")
+    b_idx = inst.rack_names.index("b")
+    assert (inst.rack_lo[a_idx], inst.rack_hi[a_idx]) == (10, 11)
+    assert (inst.rack_lo[b_idx], inst.rack_hi[b_idx]) == (9, 10)
+    # README.md:178-180 -> per-partition per-rack <= ceil(2/2) = 1
+    assert (inst.part_rack_hi == 1).all()
+
+
+def test_equal_rack_bounds_match_reference_sample():
+    # the reference LP sample pins rack totals exactly when racks are equal:
+    # 20 replicas / 2 racks -> [10, 10] (README.md:173-176)
+    current = demo_assignment()
+    topo = Topology.even_odd(range(20))
+    inst = build_instance(current, list(range(20)), topo)
+    np.testing.assert_array_equal(inst.rack_lo, [10, 10])
+    np.testing.assert_array_equal(inst.rack_hi, [10, 10])
+
+
+def test_weight_rule_matches_observed_tiers():
+    inst = build_instance(demo_assignment(), demo_broker_list(), demo_topology())
+    # partition 0: replicas [7, 18], leader 7
+    p0 = 0
+    b7 = int(np.searchsorted(inst.broker_ids, 7))
+    b18 = int(np.searchsorted(inst.broker_ids, 18))
+    assert inst.w_leader[p0, b7] == W_LEADER_KEEP == 4
+    assert inst.w_follower[p0, b7] == W_LEADER_DEMOTE == 2
+    assert inst.w_leader[p0, b18] == W_FOLLOWER_PROMOTE == 2
+    assert inst.w_follower[p0, b18] == W_FOLLOWER_KEEP == 1
+    # ineligible broker (19, being removed) earns no preservation weight
+    p1 = 1  # replicas [8, 19]
+    assert inst.w_leader[p1].sum() == W_LEADER_KEEP + 0
+    assert inst.w_follower[p1].sum() == W_LEADER_DEMOTE
+
+
+def test_identity_candidate_scores_upper_bound_when_no_broker_removed():
+    current = demo_assignment()
+    inst = build_instance(current, list(range(20)), Topology.even_odd(range(20)))
+    assert inst.preservation_weight(inst.a0) == inst.max_weight()
+    assert inst.move_count(inst.a0) == 0
+    assert inst.is_feasible(inst.a0)
+
+
+def test_violations_flag_imbalance():
+    current = demo_assignment()
+    inst = build_instance(current, list(range(20)), Topology.even_odd(range(20)))
+    a = inst.a0.copy()
+    # pile everything onto broker 0: breaks broker band + rack band + dup
+    a[:, :] = 0
+    v = inst.violations(a)
+    assert v["broker_balance"] > 0
+    assert v["duplicate_in_partition"] > 0
+
+
+def test_rf_change_instance():
+    inst = build_instance(
+        demo_assignment(), list(range(20)), Topology.even_odd(range(20)), target_rf=3
+    )
+    assert inst.max_rf == 3
+    assert inst.total_replicas == 30
+    # current a0 pads the third slot with the null bucket
+    assert (inst.a0[:, 2] == inst.num_brokers).all()
+    # per-partition per-rack cap: ceil(3/2) = 2
+    assert (inst.part_rack_hi == 2).all()
+
+
+def test_rf_exceeding_brokers_rejected():
+    with pytest.raises(ValueError):
+        build_instance(demo_assignment(), [0, 1], None, target_rf=3)
