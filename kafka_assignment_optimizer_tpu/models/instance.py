@@ -309,6 +309,26 @@ def build_instance(
     rack_lo = (r_tot * rack_sizes) // B
     rack_hi = -((-r_tot * rack_sizes) // B)
     part_rack_hi = -(-rf // K)
+    # consistency with the diversity cap (C10): with cap c_p per rack, any
+    # feasible plan puts between max(0, rf_p - c_p*(K-1)) and min(rf_p, c_p)
+    # replicas of partition p in each rack. With unequal rack sizes the
+    # proportional band can contradict that implied range (e.g. RF=2, K=2,
+    # cap=1 forces exactly P per rack); widen the band just enough to stay
+    # satisfiable. Equal-size racks reproduce the reference sample's exact
+    # bounds unchanged (README.md:173-176).
+    implied_lo = int(np.maximum(rf - part_rack_hi * (K - 1), 0).sum())
+    implied_hi = int(np.minimum(rf, part_rack_hi).sum())
+    rack_lo = np.minimum(rack_lo, implied_hi)
+    rack_hi = np.maximum(rack_hi, implied_lo)
+    # ... and the per-broker band must leave each rack's brokers enough
+    # combined capacity for the rack's forced minimum (and vice versa for
+    # the floor): e.g. a 3-broker rack forced to hold 10 replicas needs
+    # broker_hi >= ceil(10/3), whatever floor(R/B) says.
+    if K > 1:
+        forced_lo = np.maximum(rack_lo, implied_lo)
+        allowed_hi = np.minimum(rack_hi, implied_hi)
+        broker_hi = max(broker_hi, int(np.max(-(-forced_lo // rack_sizes))))
+        broker_lo = min(broker_lo, int(np.min(allowed_hi // rack_sizes)))
 
     inst = ProblemInstance(
         broker_ids=broker_ids,

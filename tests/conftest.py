@@ -5,12 +5,24 @@ cluster' strategy (SURVEY.md §4.5)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# a site plugin may have force-registered an accelerator platform and
+# overridden the env var programmatically; the config update re-selects
+# CPU as long as no backend has been initialized yet — assert loudly
+# rather than letting the suite quietly run on the wrong platform
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu" and jax.device_count() == 8, (
+    f"tests need the 8-device CPU mesh, got {jax.device_count()} "
+    f"{jax.default_backend()} device(s); a plugin initialized JAX first"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,149 @@
+"""TPU annealing-engine tests (CPU backend, 8 virtual devices).
+
+Covers SURVEY.md §4: golden demo via the tpu solver, incremental-vs-full
+score consistency (the engine's O(1) deltas against the XLA scorer and the
+numpy oracle), feasibility property tests on random clusters, and
+cross-solver parity with the exact MILP backend."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance, optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+from kafka_assignment_optimizer_tpu.ops.score import score_batch, score_one
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+from kafka_assignment_optimizer_tpu.solvers.tpu.anneal import (
+    best_key,
+    init_chain,
+    make_round_runner,
+)
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+
+def random_cluster(rng, n_brokers, n_parts, rf, n_racks, drop=0):
+    parts = []
+    for p in range(n_parts):
+        reps = rng.choice(n_brokers, size=rf, replace=False).tolist()
+        parts.append(PartitionAssignment("t", p, [int(b) for b in reps]))
+    topo = Topology(rack_of={b: f"r{b % n_racks}" for b in range(n_brokers)})
+    brokers = list(range(n_brokers - drop))
+    return Assignment(partitions=parts), brokers, topo
+
+
+def test_seed_feasible_and_minimal_on_demo(demo):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    a = greedy_seed(inst)
+    assert inst.is_feasible(a)
+    assert inst.move_count(a) == 1  # greedy already finds the optimum here
+
+
+def test_xla_scorer_matches_numpy_oracle(rng):
+    current, brokers, topo = random_cluster(rng, 12, 20, 3, 3, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    for _ in range(5):
+        a = rng.integers(0, inst.num_brokers, size=inst.a0.shape).astype(np.int32)
+        s = score_one(jnp.asarray(a), m)
+        v = inst.violations(a)
+        assert int(s.pen_broker) == v["broker_balance"]
+        assert int(s.pen_leader) == v["leader_balance"]
+        assert int(s.pen_rack) == v["rack_balance"]
+        assert int(s.pen_part_rack) == v["part_rack_diversity"]
+        assert int(s.weight) == inst.preservation_weight(a)
+
+
+def test_incremental_deltas_track_full_score(rng):
+    """After thousands of accepted moves of all three types, the chain's
+    running (w, pen, counts) must equal a from-scratch rescoring."""
+    current, brokers, topo = random_cluster(rng, 10, 16, 3, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+
+    run_round = make_round_runner(m, steps_per_round=500, axis_name=None)
+    n = 8
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    state = jax.vmap(lambda k: init_chain(m, seed, k))(keys)
+    bk = jnp.full((n,), jnp.iinfo(jnp.int32).min, jnp.int32)
+    ba = jnp.broadcast_to(seed, (n, *seed.shape))
+    for temp in [3.0, 1.0, 0.3]:  # high temp: plenty of accepted moves
+        state, bk, ba = jax.jit(run_round)(state, bk, ba, jnp.float32(temp))
+
+    full = score_batch(state.a, m)
+    np.testing.assert_array_equal(np.asarray(state.w), np.asarray(full.weight))
+    np.testing.assert_array_equal(np.asarray(state.pen), np.asarray(full.penalty))
+    np.testing.assert_array_equal(np.asarray(state.cnt), np.asarray(full.cnt))
+    np.testing.assert_array_equal(np.asarray(state.lcnt), np.asarray(full.lcnt))
+    np.testing.assert_array_equal(np.asarray(state.rcnt), np.asarray(full.rcnt))
+    # every chain keeps partitions duplicate-free (hard-encoded C8)
+    for i in range(n):
+        v = inst.violations(np.asarray(state.a[i]))
+        assert v["duplicate_in_partition"] == 0
+        assert v["null_in_valid_slot"] == 0
+    # best snapshots rank correctly
+    assert (np.asarray(bk) >= np.asarray(best_key(state)).min()).all()
+
+
+def test_tpu_solver_demo_golden(demo):
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="tpu",
+                   batch=16, rounds=6, steps_per_round=200)
+    rep = res.report()
+    assert rep["feasible"], rep
+    assert res.replica_moves == 1
+    assert res.solve.objective == res.instance.max_weight()
+
+
+@pytest.mark.parametrize("case", [
+    dict(n_brokers=8, n_parts=12, rf=2, n_racks=2, drop=1),
+    dict(n_brokers=9, n_parts=10, rf=3, n_racks=3, drop=0),
+    dict(n_brokers=12, n_parts=18, rf=2, n_racks=4, drop=2),
+])
+def test_property_feasible_plans_random_clusters(case, rng):
+    current, brokers, topo = random_cluster(rng, **case)
+    res = optimize(current, brokers, topo, solver="tpu",
+                   batch=16, rounds=8, steps_per_round=300)
+    rep = res.report()
+    assert rep["feasible"], rep
+    # replica lists well-formed: right RF, unique brokers, eligible only
+    for p in res.assignment.partitions:
+        assert len(p.replicas) == len(set(p.replicas))
+        assert set(p.replicas) <= set(brokers)
+
+
+def test_cross_solver_parity_small(rng):
+    """North-star quality gate (SURVEY.md §4.4): on exactly solvable
+    instances the search must reach the ILP optimum."""
+    current, brokers, topo = random_cluster(rng, 8, 10, 2, 2, drop=1)
+    exact = optimize(current, brokers, topo, solver="milp")
+    search = optimize(current, brokers, topo, solver="tpu",
+                      batch=24, rounds=10, steps_per_round=400)
+    assert search.report()["feasible"]
+    assert search.replica_moves <= exact.replica_moves
+    assert search.solve.objective == exact.solve.objective
+
+
+def test_leader_only_rebalance_zero_replica_moves():
+    """BASELINE.json config 5: skewed leadership, balanced replicas —
+    the optimizer must fix leader skew with zero replica moves."""
+    # 6 brokers, 12 partitions RF=2, all leaders piled on brokers 0..2
+    parts = []
+    for p in range(12):
+        lead = p % 3
+        foll = 3 + (p % 3)
+        parts.append(PartitionAssignment("t", p, [lead, foll]))
+    current = Assignment(partitions=parts)
+    topo = Topology.single_rack(range(6))
+    res = optimize(current, list(range(6)), topo, solver="tpu",
+                   batch=16, rounds=8, steps_per_round=300)
+    rep = res.report()
+    assert rep["feasible"], rep
+    assert res.replica_moves == 0
+    assert res.moves.leader_changes > 0  # skew actually fixed
