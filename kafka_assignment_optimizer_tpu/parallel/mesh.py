@@ -21,8 +21,6 @@ Works identically on one real TPU, a v5e-8 slice, or the CPU test mesh
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -40,6 +38,57 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
+# compiled sharded solvers, keyed by (device ids, search params); the model
+# is a runtime argument, so jax.jit's own shape keying handles different
+# instance sizes and *warm re-solves of same-shape instances skip
+# compilation entirely*
+_COMPILED: dict[tuple, object] = {}
+
+
+def _compiled_solver(
+    mesh: Mesh,
+    chains_per_device: int,
+    rounds: int,
+    steps_per_round: int,
+    t_hi: float,
+    t_lo: float,
+):
+    from ..solvers.tpu.anneal import make_solver_fn
+
+    cache_key = (
+        tuple(d.id for d in mesh.devices.flat),
+        chains_per_device, rounds, steps_per_round, float(t_hi), float(t_lo),
+    )
+    fn = _COMPILED.get(cache_key)
+    if fn is None:
+        # shard_map introduces the mesh axis even for a single device, so
+        # the solver always anneals with axis_name set here (collectives
+        # over a singleton axis are free)
+        solve = make_solver_fn(
+            chains_per_device,
+            rounds,
+            steps_per_round,
+            t_hi=t_hi,
+            t_lo=t_lo,
+            axis_name=AXIS,
+        )
+
+        def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array, keys: jax.Array):
+            best_a, best_k = solve(m_rep, seed_rep, keys[0])
+            return best_a[None], best_k[None]
+
+        fn = jax.jit(
+            jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(), P(), P(AXIS)),
+                out_specs=(P(AXIS), P(AXIS)),
+            )
+        )
+        _COMPILED[cache_key] = fn
+    return fn
+
+
 def solve_on_mesh(
     m: ModelArrays,
     a_seed: jax.Array,
@@ -53,38 +102,12 @@ def solve_on_mesh(
 ):
     """Run the annealer sharded over `mesh`; returns (best_a [P, R],
     best_key scalar) after a host-side reduce over shards."""
-    from ..solvers.tpu.anneal import make_solver_fn
-
     n_dev = mesh.devices.size
-    # shard_map introduces the mesh axis even for a single device, so the
-    # solver always anneals with axis_name set here (collectives over a
-    # singleton axis are free)
-    solve = make_solver_fn(
-        m,
-        chains_per_device,
-        rounds,
-        steps_per_round,
-        t_hi=t_hi,
-        t_lo=t_lo,
-        axis_name=AXIS,
+    fn = _compiled_solver(
+        mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo
     )
-
-    def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array, keys: jax.Array):
-        best_a, best_k = solve_with(m_rep, seed_rep, keys[0])
-        return best_a[None], best_k[None]
-
-    # close over nothing device-dependent; model + seed replicated
-    def solve_with(m_rep, seed_rep, k):
-        return solve(seed_rep, k)
-
     keys = jax.random.split(key, n_dev)
-    mapped = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P(), P(), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS)),
-    )
-    best_a, best_k = jax.jit(mapped)(m, a_seed, keys)
+    best_a, best_k = fn(m, a_seed, keys)
     best_a, best_k = jax.device_get((best_a, best_k))
     top = int(np.argmax(best_k))
     return best_a[top], int(best_k[top])

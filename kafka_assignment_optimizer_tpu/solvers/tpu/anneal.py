@@ -26,8 +26,6 @@ wandering can never lose the best feasible plan found.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax, random
@@ -37,6 +35,11 @@ from .arrays import LAMBDA, SCALE_W, ModelArrays
 # move-type proposal mix
 P_REPLACE = 0.45
 P_LSWAP = 0.10  # remainder goes to xswap
+# within `replace`: probability of proposing the partition's ORIGINAL
+# broker for the slot (a restore) instead of a uniform one — the move that
+# claws preservation weight back after high-temperature wandering and
+# walks seeds toward the move-count optimum
+P_RESTORE = 0.5
 
 
 @jax.tree_util.register_dataclass
@@ -94,21 +97,36 @@ def _delta_band(c_from, c_to, lo, hi):
     )
 
 
-def _anneal_step(m: ModelArrays, st: ChainState, temp: jax.Array) -> ChainState:
-    """One Metropolis step for one chain. O(RF) work, all where-selects."""
+def _u01(bits: jax.Array) -> jax.Array:
+    """uint32 -> uniform float32 in [0, 1) via the top 24 bits."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24)
+    )
+
+
+def _anneal_step(
+    m: ModelArrays, st: ChainState, temp: jax.Array, row: jax.Array
+) -> ChainState:
+    """One Metropolis step for one chain. O(RF) work, all where-selects.
+
+    ``row`` is a [8] uint32 vector of presampled random bits (one
+    ``random.bits`` call per ROUND generates all of them — keeping threefry
+    key-splitting out of the hot loop is worth ~10x on step latency).
+    Modulo bias from ``bits % n`` is negligible for n << 2^32.
+    """
     P, R = m.a0.shape
     B, K = m.num_brokers, m.num_racks
     i32 = jnp.int32
+    u32 = jnp.uint32
 
-    key, k_p, k_type, k_slot, k_b, k_p2, k_s2, k_u = random.split(st.key, 8)
-    p = random.randint(k_p, (), 0, P)
+    p = (row[0] % u32(P)).astype(i32)
     rfp = m.rf[p]
-    u_type = random.uniform(k_type)
+    u_type = _u01(row[1])
     is_rep = u_type < P_REPLACE
     is_lsw = jnp.logical_and(u_type >= P_REPLACE, u_type < P_REPLACE + P_LSWAP)
     is_xsw = jnp.logical_not(jnp.logical_or(is_rep, is_lsw))
 
-    s_raw = random.randint(k_slot, (), 0, 1 << 30)
+    s_raw = (row[2] & u32(0x3FFFFFFF)).astype(i32)
     s_rep = s_raw % rfp
     s_lsw = 1 + s_raw % jnp.maximum(rfp - 1, 1)
     s1 = jnp.where(is_lsw, s_lsw, s_rep)
@@ -116,12 +134,19 @@ def _anneal_step(m: ModelArrays, st: ChainState, temp: jax.Array) -> ChainState:
     row1 = st.a[p]  # [R]
     valid1 = m.slot_valid[p]
     b_old = row1[s1]
-    b_new_rep = random.randint(k_b, (), 0, B)
+    # replace proposal: restore the slot's original broker with prob
+    # P_RESTORE (when it exists and is eligible), else uniform
+    b_uni = (row[3] % u32(B)).astype(i32)
+    s_orig = ((row[7] & u32(0xFFFF)) % u32(R)).astype(i32)
+    b_orig = m.a0[p, s_orig]
+    b_new_rep = jnp.where(
+        jnp.logical_and(_u01(row[7]) < P_RESTORE, b_orig < B), b_orig, b_uni
+    )
 
     # second site for xswap
-    p2 = random.randint(k_p2, (), 0, P)
+    p2 = (row[4] % u32(P)).astype(i32)
     rfp2 = m.rf[p2]
-    s2 = random.randint(k_s2, (), 0, 1 << 30) % rfp2
+    s2 = (row[5] & u32(0x3FFFFFFF)).astype(i32) % rfp2
     row2 = st.a[p2]
     valid2 = m.slot_valid[p2]
     b2 = row2[s2]
@@ -221,7 +246,8 @@ def _anneal_step(m: ModelArrays, st: ChainState, temp: jax.Array) -> ChainState:
     accept = jnp.logical_and(
         valid,
         jnp.logical_or(
-            delta >= 0, random.uniform(k_u) < jnp.exp(delta / jnp.maximum(temp, 1e-6))
+            delta >= 0,
+            _u01(row[6]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
         ),
     )
 
@@ -262,28 +288,37 @@ def _anneal_step(m: ModelArrays, st: ChainState, temp: jax.Array) -> ChainState:
         rcnt=rcnt,
         pen=st.pen + jnp.where(accept, dpen, 0),
         w=st.w + jnp.where(accept, dw, 0),
-        key=key,
+        key=st.key,
     )
 
 
-def make_round_runner(m: ModelArrays, steps_per_round: int, axis_name: str | None):
-    """Build the jittable (state, best) -> (state, best) round function:
+def make_round_runner(steps_per_round: int, axis_name: str | None):
+    """Build the jittable (m, state, best) -> (state, best) round function:
     `steps_per_round` annealing steps, a feasible-best snapshot, and (on a
     mesh) migration of the global best into each shard's worst chain via
-    ICI collectives."""
+    ICI collectives. ``m`` is an argument (not a closure) so one compiled
+    executable serves every same-shape instance."""
 
-    def one_chain_steps(st: ChainState, temp: jax.Array) -> ChainState:
-        def body(s, _):
-            return _anneal_step(m, s, temp), None
+    def one_chain_steps(
+        m: ModelArrays, st: ChainState, temp: jax.Array
+    ) -> ChainState:
+        key, sub = random.split(st.key)
+        bits = random.bits(sub, (steps_per_round, 8), jnp.uint32)
 
-        st, _ = lax.scan(body, st, None, length=steps_per_round)
-        return st
+        def body(s, row):
+            return _anneal_step(m, s, temp, row), None
 
-    batched_steps = jax.vmap(one_chain_steps, in_axes=(0, None))
+        st, _ = lax.scan(body, st, bits)
+        return ChainState(
+            a=st.a, cnt=st.cnt, lcnt=st.lcnt, rcnt=st.rcnt,
+            pen=st.pen, w=st.w, key=key,
+        )
 
-    def run_round(state: ChainState, best_k: jax.Array, best_a: jax.Array,
-                  temp: jax.Array):
-        state = batched_steps(state, temp)
+    batched_steps = jax.vmap(one_chain_steps, in_axes=(None, 0, None))
+
+    def run_round(m: ModelArrays, state: ChainState, best_k: jax.Array,
+                  best_a: jax.Array, temp: jax.Array):
+        state = batched_steps(m, state, temp)
         k = best_key(state)  # [N]
         improved = k > best_k
         best_k = jnp.where(improved, k, best_k)
@@ -329,7 +364,6 @@ def make_round_runner(m: ModelArrays, steps_per_round: int, axis_name: str | Non
 
 
 def make_solver_fn(
-    m: ModelArrays,
     n_chains: int,
     rounds: int,
     steps_per_round: int,
@@ -337,18 +371,23 @@ def make_solver_fn(
     t_lo: float = 0.05,
     axis_name: str | None = None,
 ):
-    """Full anneal as one jittable function: seed [P, R] + base key ->
-    (best_a [P, R], best_key scalar) for this shard."""
-    run_round = make_round_runner(m, steps_per_round, axis_name)
+    """Full anneal as one jittable function: model + seed [P, R] + base key
+    -> (best_a [P, R], best_key scalar) for this shard. The model is a
+    runtime argument, so jitting the returned function once covers every
+    instance of the same shape (warm re-solves skip compilation)."""
+    run_round = make_round_runner(steps_per_round, axis_name)
     temps = jnp.asarray(
         t_hi * (t_lo / t_hi) ** (jnp.arange(rounds) / max(rounds - 1, 1)),
         jnp.float32,
     )
 
-    def solve(a_seed: jax.Array, key: jax.Array):
+    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
         keys = random.split(key, n_chains)
         state = jax.vmap(lambda k: init_chain(m, a_seed, k))(keys)
-        best_k = jnp.full((n_chains,), jnp.iinfo(jnp.int32).min, jnp.int32)
+        # snapshot the SEED itself before any annealing: high-temperature
+        # rounds may never re-reach a good (often near-optimal) warm start,
+        # so the final answer must be at least as good as the seed
+        best_k = best_key(state)
         best_a = jnp.broadcast_to(
             a_seed.astype(jnp.int32), (n_chains, *a_seed.shape)
         )
@@ -367,7 +406,7 @@ def make_solver_fn(
 
         def body(carry, temp):
             state, bk, ba = carry
-            state, bk, ba = run_round(state, bk, ba, temp)
+            state, bk, ba = run_round(m, state, bk, ba, temp)
             return (state, bk, ba), None
 
         (state, best_k, best_a), _ = lax.scan(

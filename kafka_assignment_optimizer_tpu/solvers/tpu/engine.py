@@ -91,12 +91,6 @@ def solve_tpu(
     viol = inst.violations(best_a)
     weight = inst.preservation_weight(best_a)
     feasible = all(v == 0 for v in viol.values())
-    # a feasible annealed plan can never be worse than the greedy seed;
-    # fall back defensively if the search degraded (never expected)
-    seed_viol = inst.violations(a_seed)
-    if not feasible and all(v == 0 for v in seed_viol.values()):
-        best_a, viol, feasible = a_seed, seed_viol, True
-        weight = inst.preservation_weight(best_a)
 
     return SolveResult(
         a=best_a,

@@ -156,16 +156,28 @@ class _Repair:
 
     def relocate_one(self, src: int, dst_mask: np.ndarray) -> bool:
         """Move the least-weight replica off `src` to the best allowed
-        broker. Tries donor slots cheapest-first."""
+        broker. Tries donor slots cheapest-first, and keeps scanning past
+        placements that would break per-partition rack diversity, taking
+        one only as a last resort."""
+        inst, rack = self.inst, self.rack[: self.B]
         slots = sorted(
             self.slots_of[src],
             key=lambda ps: (self.weight(ps[0], ps[1], src), ps),
         )
+        fallback: tuple[int, int, int] | None = None
         for p, s in slots:
             b = self.choose_broker(p, dst_mask & ~self.used_mask(p))
-            if b >= 0:
+            if b < 0:
+                continue
+            same_rack = rack[b] == rack[src]  # donor replica leaves that rack
+            if self.prc[p, rack[b]] + 1 - same_rack <= inst.part_rack_hi[p]:
                 self.set_slot(p, s, b)
                 return True
+            if fallback is None:
+                fallback = (p, s, b)
+        if fallback is not None:
+            self.set_slot(*fallback)
+            return True
         return False
 
     def fix_bands(self, max_repairs: int) -> None:
@@ -204,52 +216,159 @@ class _Repair:
 
     def fix_leaders(self, max_repairs: int) -> None:
         inst, B = self.inst, self.B
-        # leaders per broker -> partitions led, for targeted swaps
-        led_by: list[set[int]] = [set() for _ in range(B)]
-        for p in range(self.P):
-            if int(self.rf[p]) > 0 and int(self.a[p, 0]) < B:
-                led_by[int(self.a[p, 0])].add(p)
 
         def swap(p: int, s: int) -> None:
             bl, bf = int(self.a[p, 0]), int(self.a[p, s])
             self.a[p, 0], self.a[p, s] = bf, bl
             self.lcnt[bl] -= 1
             self.lcnt[bf] += 1
-            led_by[bl].discard(p)
-            led_by[bf].add(p)
             self.slots_of[bl].discard((p, 0))
             self.slots_of[bl].add((p, s))
             self.slots_of[bf].discard((p, s))
             self.slots_of[bf].add((p, 0))
 
+        # phase 1 — potential descent: repeatedly hand leadership of some
+        # partition to its least-leading follower while that strictly
+        # decreases sum(lcnt^2) (gain >= 2). Each swap drops the potential
+        # by >= 2, so this terminates, and the balanced profile is its
+        # global minimum — it walks straight through the multi-hop chains
+        # the band-targeted phase below cannot see.
+        if self.R > 1:
+            foll = self.a[:, 1:]  # [P, R-1]
+            foll_valid = (np.arange(1, self.R)[None, :] < self.rf[:, None]) & (
+                foll < B
+            )
+            for _ in range(max_repairs):
+                lead = self.a[:, 0]
+                safe_lead = np.where(lead < B, lead, 0)
+                l_of_lead = np.where(lead < B, self.lcnt[safe_lead], -1)
+                f_cnt = np.where(foll_valid, self.lcnt[np.minimum(foll, B - 1)],
+                                 np.iinfo(np.int64).max)
+                s_best = np.argmin(f_cnt, axis=1)
+                f_best = f_cnt[np.arange(self.P), s_best]
+                gain = l_of_lead - np.where(f_best < np.iinfo(np.int64).max,
+                                            f_best, np.iinfo(np.int64).max)
+                p = int(np.argmax(gain))
+                if gain[p] < 2:
+                    break
+                swap(p, int(s_best[p]) + 1)
+
+        # phase 2 — band-violation descent with bounded neutral chaining:
+        # vectorized over partitions, pick the leader<->follower swap with
+        # the most negative band-violation delta; when only neutral swaps
+        # exist (delta 0), take the one with the largest potential gain —
+        # these walk the multi-hop chains (A->B then B->C) a strict descent
+        # cannot, with a stall budget so cycles terminate.
+        if self.R <= 1:
+            return
+        lo, hi = inst.leader_lo, inst.leader_hi
+        foll = self.a[:, 1:]
+        foll_valid = (np.arange(1, self.R)[None, :] < self.rf[:, None]) & (
+            foll < B
+        )
+
+        def bv(c):
+            return np.maximum(c - hi, 0) + np.maximum(lo - c, 0)
+
+        stall = 0
+        prev_p = -1  # neutral moves never revisit the partition just swapped
         for _ in range(max_repairs):
-            over = np.flatnonzero(self.lcnt > inst.leader_hi)
-            under = np.flatnonzero(self.lcnt < inst.leader_lo)
-            done = False
-            if len(over):
-                src = int(over[np.argmax(self.lcnt[over])])
-                for p in led_by[src]:
-                    cands = [
-                        s
-                        for s in range(1, int(self.rf[p]))
-                        if self.lcnt[int(self.a[p, s])] < inst.leader_hi
-                    ]
-                    if cands:
-                        s = min(cands, key=lambda s: self.lcnt[int(self.a[p, s])])
-                        swap(p, s)
-                        done = True
-                        break
-            elif len(under):
-                dst = int(under[0])
-                for (p, s) in self.slots_of[dst]:
-                    if s == 0 or int(self.rf[p]) < 2:
-                        continue
-                    if self.lcnt[int(self.a[p, 0])] > inst.leader_lo:
-                        swap(p, s)
-                        done = True
-                        break
-            if not done:
+            if not (bv(self.lcnt) > 0).any():
                 break
+            lead = self.a[:, 0]
+            safe_lead = np.where(lead < B, lead, 0)
+            lc = self.lcnt[safe_lead]
+            fc = np.where(
+                foll_valid,
+                self.lcnt[np.minimum(foll, B - 1)],
+                np.iinfo(np.int64).max // 2,
+            )
+            s_best = np.argmin(fc, axis=1)
+            f_best = fc[np.arange(self.P), s_best]
+            usable = (lead < B) & (f_best < np.iinfo(np.int64).max // 2)
+            # swap delta on total band violation (lead -1, follower +1)
+            dviol = np.where(
+                usable,
+                bv(lc - 1) - bv(lc) + bv(f_best + 1) - bv(f_best),
+                np.iinfo(np.int64).max // 2,
+            )
+            gain = np.where(usable, lc - f_best, np.iinfo(np.int64).min // 2)
+            order = np.lexsort((-gain, dviol))
+            p = int(order[0])
+            if dviol[p] >= 0 and p == prev_p and self.P > 1:
+                p = int(order[1])
+            if dviol[p] < 0:
+                stall = 0
+            elif dviol[p] == 0 and gain[p] >= 1 and stall < 4 * self.B:
+                stall += 1
+            else:
+                break
+            swap(p, int(s_best[p]) + 1)
+            prev_p = p
+
+        # phase 3 — BFS augmenting chains for what descent cannot reach:
+        # route one unit of leadership from an over-hi broker to any broker
+        # with headroom (or from any broker with slack to an under-lo one)
+        # through a path of leader<->follower swaps. Exact; each
+        # augmentation reduces total band violation by >= 1.
+        for _ in range(max_repairs):
+            over = np.flatnonzero(self.lcnt > hi)
+            under = np.flatnonzero(self.lcnt < lo)
+            if not (len(over) or len(under)):
+                break
+            # edges: leader broker -> (follower broker, partition, slot)
+            adj: dict[int, list[tuple[int, int, int]]] = {}
+            for p in range(self.P):
+                L = int(self.a[p, 0])
+                if L >= B:
+                    continue
+                for s in range(1, int(self.rf[p])):
+                    F = int(self.a[p, s])
+                    if F < B:
+                        adj.setdefault(L, []).append((F, p, s))
+            if len(over):
+                # shed excess: over-hi broker -> any broker with headroom
+                srcs = {int(b) for b in over}
+                is_dst = lambda b: self.lcnt[b] < hi  # noqa: E731
+            else:
+                # feed deficit: any broker with slack -> the under-lo broker
+                # (swaps shift leadership forward along the same edges)
+                srcs = {b for b in range(B) if self.lcnt[b] > lo}
+                dst_set = {int(b) for b in under}
+                is_dst = lambda b: b in dst_set  # noqa: E731
+            parent: dict[int, tuple[int, int, int]] = {}
+            frontier = list(srcs)
+            seen = set(srcs)
+            goal = -1
+            while frontier and goal < 0:
+                nxt = []
+                for u in frontier:
+                    for (v, p, s) in adj.get(u, []):
+                        if v in seen:
+                            continue
+                        seen.add(v)
+                        parent[v] = (u, p, s)
+                        if is_dst(v):
+                            goal = v
+                            break
+                        nxt.append(v)
+                    if goal >= 0:
+                        break
+                frontier = nxt
+            if goal < 0:
+                break  # disconnected; annealer's job
+            # unwind: swap along the path so leadership shifts one hop per
+            # edge. A partition can appear on two path edges (its leadership
+            # already moved), invalidating the later swap — guard and
+            # re-BFS on the next outer iteration.
+            node = goal
+            ok = True
+            while node not in srcs and ok:
+                u, p, s = parent[node]
+                ok = int(self.a[p, 0]) == u and int(self.a[p, s]) == node
+                if ok:
+                    swap(p, s)
+                node = u
 
 
 def greedy_seed(inst: ProblemInstance, max_repairs: int | None = None) -> np.ndarray:
@@ -257,6 +376,11 @@ def greedy_seed(inst: ProblemInstance, max_repairs: int | None = None) -> np.nda
         max_repairs = 4 * int(inst.rf.sum()) + 64
     r = _Repair(inst)
     r.fill_nulls()
+    r.fix_diversity()
+    r.fix_bands(max_repairs)
+    # band repair can occasionally be forced into a diversity-violating
+    # placement (every allowed broker's rack full for that partition);
+    # one more pass of each usually clears it
     r.fix_diversity()
     r.fix_bands(max_repairs)
     r.fix_leaders(max_repairs)

@@ -67,14 +67,14 @@ def test_incremental_deltas_track_full_score(rng):
     m = arrays.from_instance(inst)
     seed = jnp.asarray(greedy_seed(inst), jnp.int32)
 
-    run_round = make_round_runner(m, steps_per_round=500, axis_name=None)
+    run_round = make_round_runner(steps_per_round=500, axis_name=None)
     n = 8
     keys = jax.random.split(jax.random.PRNGKey(42), n)
     state = jax.vmap(lambda k: init_chain(m, seed, k))(keys)
     bk = jnp.full((n,), jnp.iinfo(jnp.int32).min, jnp.int32)
     ba = jnp.broadcast_to(seed, (n, *seed.shape))
     for temp in [3.0, 1.0, 0.3]:  # high temp: plenty of accepted moves
-        state, bk, ba = jax.jit(run_round)(state, bk, ba, jnp.float32(temp))
+        state, bk, ba = jax.jit(run_round)(m, state, bk, ba, jnp.float32(temp))
 
     full = score_batch(state.a, m)
     np.testing.assert_array_equal(np.asarray(state.w), np.asarray(full.weight))
