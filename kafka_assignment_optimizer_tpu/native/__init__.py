@@ -1,0 +1,78 @@
+"""Native (C++) runtime components and their build/loading machinery.
+
+The reference's native component is the external lp_solve 5.5 C solver it
+shells out to (``/root/reference/README.md:135-137``). This package bundles
+the equivalent *in-process*: ``bb.cpp`` — a specialized exact
+branch-and-bound for the reassignment model — compiled on first use with
+the system ``g++`` into a cached shared library and bound via ctypes
+(no pybind11 dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_SRC = Path(__file__).with_name("bb.cpp")
+
+
+def _build_dir() -> Path:
+    d = Path(__file__).with_name("_build")
+    d.mkdir(exist_ok=True)
+    return d
+
+
+def lib_path() -> Path:
+    """Content-addressed artifact path: a source edit changes the hash, so
+    stale libraries are never loaded and parallel builds never collide."""
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    return _build_dir() / f"libkao_{digest}.so"
+
+
+def build(verbose: bool = False) -> Path:
+    out = lib_path()
+    if out.exists():
+        return out
+    with tempfile.TemporaryDirectory(dir=_build_dir()) as td:
+        tmp = Path(td) / out.name
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+            "-Wall", "-Wextra",
+            str(_SRC), "-o", str(tmp),
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}"
+            )
+        if verbose and proc.stderr:
+            print(proc.stderr)
+        os.replace(tmp, out)  # atomic publish
+    return out
+
+
+_LIB: ctypes.CDLL | None = None
+
+
+def load() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(str(build()))
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.kao_solve.restype = ctypes.c_int
+        lib.kao_solve.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # P B K R
+            i32p, i32p, i32p, i32p,  # rf rack_of w_leader w_follower
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # bands
+            i32p, i32p, i32p,  # rack_lo rack_hi part_rack_hi
+            i32p, ctypes.c_int64, ctypes.c_int,  # seed_a seed_w has_seed
+            ctypes.c_double,  # time limit
+            i32p, i64p, i64p,  # out_a out_objective out_nodes
+        ]
+        _LIB = lib
+    return _LIB

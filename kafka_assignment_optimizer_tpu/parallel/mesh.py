@@ -41,8 +41,10 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 # compiled sharded solvers, keyed by (device ids, search params); the model
 # is a runtime argument, so jax.jit's own shape keying handles different
 # instance sizes and *warm re-solves of same-shape instances skip
-# compilation entirely*
+# compilation entirely*. Bounded: a long-lived service solving a stream of
+# differently sized instances must not accumulate executables forever.
 _COMPILED: dict[tuple, object] = {}
+_COMPILED_MAX = 16
 
 
 def _compiled_solver(
@@ -61,6 +63,8 @@ def _compiled_solver(
     )
     fn = _COMPILED.get(cache_key)
     if fn is None:
+        if len(_COMPILED) >= _COMPILED_MAX:  # evict oldest (insertion order)
+            _COMPILED.pop(next(iter(_COMPILED)))
         # shard_map introduces the mesh axis even for a single device, so
         # the solver always anneals with axis_name set here (collectives
         # over a singleton axis are free)

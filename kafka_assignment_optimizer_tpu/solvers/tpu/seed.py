@@ -358,16 +358,14 @@ class _Repair:
             if goal < 0:
                 break  # disconnected; annealer's job
             # unwind: swap along the path so leadership shifts one hop per
-            # edge. A partition can appear on two path edges (its leadership
-            # already moved), invalidating the later swap — guard and
-            # re-BFS on the next outer iteration.
+            # edge. Path nodes (leader brokers) are distinct and each
+            # partition has exactly one leader when adj was built, so every
+            # edge's swap is still valid at unwind time — the augmentation
+            # always applies in full, shifting one leader off the source.
             node = goal
-            ok = True
-            while node not in srcs and ok:
+            while node not in srcs:
                 u, p, s = parent[node]
-                ok = int(self.a[p, 0]) == u and int(self.a[p, s]) == node
-                if ok:
-                    swap(p, s)
+                swap(p, s)
                 node = u
 
 

@@ -1,0 +1,66 @@
+"""Native C++ branch-and-bound backend tests (SURVEY.md §4.4 parity).
+
+The native solver plays lp_solve's role for the reference
+(``/root/reference/README.md:135-137``): the exact solve. Exactness is
+asserted against the independent HiGHS MILP oracle — same objective on the
+demo and on random clusters — plus golden move count and time-limit
+behavior."""
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance, optimize
+from kafka_assignment_optimizer_tpu.solvers.base import get_solver
+
+from tests.test_tpu_engine import random_cluster
+
+
+def test_native_demo_golden(demo):
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="native")
+    rep = res.report()
+    assert rep["feasible"], rep
+    assert res.solve.optimal
+    assert res.replica_moves == 1  # README.md:85-91 known optimum
+    assert res.solve.objective == res.instance.max_weight()
+
+
+@pytest.mark.parametrize("case", [
+    dict(n_brokers=8, n_parts=12, rf=2, n_racks=2, drop=1),
+    dict(n_brokers=9, n_parts=10, rf=3, n_racks=3, drop=0),
+    dict(n_brokers=12, n_parts=18, rf=2, n_racks=4, drop=2),
+    dict(n_brokers=6, n_parts=8, rf=1, n_racks=2, drop=1),  # RF=1 edge
+    dict(n_brokers=10, n_parts=7, rf=4, n_racks=2, drop=1),
+])
+def test_native_matches_milp_oracle(case, rng):
+    """Exactness: independent exact backends must agree on the optimum."""
+    current, brokers, topo = random_cluster(rng, **case)
+    inst = build_instance(current, brokers, topo)
+    nat = get_solver("native")(inst)
+    ilp = get_solver("milp")(inst)
+    assert nat.optimal and ilp.optimal
+    assert inst.is_feasible(nat.a), inst.violations(nat.a)
+    assert nat.objective == inst.preservation_weight(nat.a)
+    assert nat.objective == ilp.objective
+
+
+def test_native_objective_is_exact_recount(rng):
+    current, brokers, topo = random_cluster(rng, 8, 10, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    res = get_solver("native")(inst)
+    assert res.objective == inst.preservation_weight(res.a)
+    assert res.a.shape == (inst.num_parts, inst.max_rf)
+    assert res.a.dtype == np.int32
+
+
+def test_native_time_limit(rng):
+    """A too-small budget must return cleanly: either a (possibly
+    suboptimal) incumbent or a diagnosable no-solution error."""
+    current, brokers, topo = random_cluster(rng, 24, 120, 3, 4, drop=2)
+    inst = build_instance(current, brokers, topo)
+    try:
+        res = get_solver("native")(inst, time_limit_s=0.05)
+    except RuntimeError as e:
+        assert "no solution" in str(e)
+    else:
+        assert inst.is_feasible(res.a) or not res.optimal
