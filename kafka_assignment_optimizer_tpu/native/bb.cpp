@@ -194,8 +194,8 @@ class Solver {
   }
 
   // deficits must be coverable by what is still to be placed
-  bool deficits_ok(int next_level, int placed_in_current) const {
-    const int64_t rem = rem_replicas_[next_level] - placed_in_current;
+  bool deficits_ok(int next_level) const {
+    const int64_t rem = rem_replicas_[next_level];
     const int64_t rem_parts = pr_.P - next_level;  // leaders still to place
     return broker_deficit_ <= rem && rack_deficit_ <= rem &&
            leader_deficit_ <= rem_parts;
@@ -259,7 +259,7 @@ class Solver {
   void followers(int level, int p, int slot, int min_pos, int bl, int64_t w) {
     if (stats_.timed_out) return;
     if (slot == pr_.rf[p]) {
-      if (deficits_ok(level + 1, 0)) dfs(level + 1, w);
+      if (deficits_ok(level + 1)) dfs(level + 1, w);
       return;
     }
     const int remaining = pr_.rf[p] - slot;

@@ -62,7 +62,9 @@ def _compiled_solver(
         chains_per_device, rounds, steps_per_round, float(t_hi), float(t_lo),
     )
     fn = _COMPILED.get(cache_key)
-    if fn is None:
+    if fn is not None:  # LRU refresh: insertion order tracks recency
+        _COMPILED[cache_key] = _COMPILED.pop(cache_key)
+    else:
         if len(_COMPILED) >= _COMPILED_MAX:  # evict oldest (insertion order)
             _COMPILED.pop(next(iter(_COMPILED)))
         # shard_map introduces the mesh axis even for a single device, so

@@ -1,0 +1,60 @@
+"""Pallas scoring-kernel parity (SURVEY.md §7 hard part 3): the tiled
+kernel must agree exactly — integer-for-integer — with the pure-XLA scorer
+and the numpy oracle, across batch sizes, non-tile-aligned partition
+counts, variable RF, and infeasible candidates. Runs in interpret mode on
+the CPU mesh; the same kernel compiles natively on TPU."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.ops.score import score_batch
+from kafka_assignment_optimizer_tpu.ops.score_pallas import score_batch_pallas
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+
+from tests.test_tpu_engine import random_cluster
+
+
+@pytest.mark.parametrize("case", [
+    dict(n_brokers=12, n_parts=20, rf=3, n_racks=3, drop=1),
+    dict(n_brokers=8, n_parts=7, rf=2, n_racks=2, drop=0),   # P < tile
+    dict(n_brokers=20, n_parts=33, rf=4, n_racks=5, drop=2),  # odd P
+    dict(n_brokers=6, n_parts=9, rf=1, n_racks=2, drop=0),   # RF=1 edge
+])
+def test_pallas_scorer_matches_xla(case, rng):
+    current, brokers, topo = random_cluster(rng, **case)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    n = 5
+    a = rng.integers(
+        0, inst.num_brokers, size=(n, *inst.a0.shape)
+    ).astype(np.int32)
+    ref = score_batch(jnp.asarray(a), m)
+    got = score_batch_pallas(jnp.asarray(a), m, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got.weight), np.asarray(ref.weight))
+    np.testing.assert_array_equal(np.asarray(got.pen_broker), np.asarray(ref.pen_broker))
+    np.testing.assert_array_equal(np.asarray(got.pen_leader), np.asarray(ref.pen_leader))
+    np.testing.assert_array_equal(np.asarray(got.pen_rack), np.asarray(ref.pen_rack))
+    np.testing.assert_array_equal(
+        np.asarray(got.pen_part_rack), np.asarray(ref.pen_part_rack)
+    )
+    np.testing.assert_array_equal(np.asarray(got.cnt), np.asarray(ref.cnt))
+    np.testing.assert_array_equal(np.asarray(got.lcnt), np.asarray(ref.lcnt))
+    np.testing.assert_array_equal(np.asarray(got.rcnt), np.asarray(ref.rcnt))
+
+
+def test_pallas_scorer_matches_numpy_oracle(rng):
+    """Transitively: kernel == XLA == numpy; assert the endpoints too."""
+    current, brokers, topo = random_cluster(rng, 10, 15, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    a = rng.integers(0, inst.num_brokers, size=(3, *inst.a0.shape)).astype(np.int32)
+    got = score_batch_pallas(jnp.asarray(a), m, interpret=True)
+    for i in range(a.shape[0]):
+        v = inst.violations(a[i])
+        assert int(got.weight[i]) == inst.preservation_weight(a[i])
+        assert int(got.pen_broker[i]) == v["broker_balance"]
+        assert int(got.pen_leader[i]) == v["leader_balance"]
+        assert int(got.pen_rack[i]) == v["rack_balance"]
+        assert int(got.pen_part_rack[i]) == v["part_rack_diversity"]
