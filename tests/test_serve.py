@@ -1,0 +1,120 @@
+"""HTTP service tests (reference C16, ``README.md:187-195``): a real
+ThreadingHTTPServer on an ephemeral port, exercised with urllib — the
+golden demo through POST /submit, schema error paths, and /healthz."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.models.cluster import demo_assignment
+from kafka_assignment_optimizer_tpu.serve import ApiError, handle_submit, make_server
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    srv = make_server(port=0)  # ephemeral port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url + "/submit",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_submit_demo_golden(server_url):
+    status, body = post(server_url, {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "milp",
+    })
+    assert status == 200, body
+    rep = body["report"]
+    assert rep["replica_moves"] == 1 and rep["feasible"]
+    plan = {p["partition"]: p["replicas"] for p in body["assignment"]["partitions"]}
+    assert plan[1][0] == 8 and plan[1][1] % 2 == 1  # leader kept, odd AZ
+
+
+def test_submit_solver_options(server_url):
+    status, body = post(server_url, {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": list(range(19)),
+        "topology": "even-odd",
+        "solver": "tpu",
+        "options": {"batch": 8, "rounds": 4, "steps_per_round": 100},
+    })
+    assert status == 200, body
+    assert body["report"]["feasible"]
+
+
+@pytest.mark.parametrize("payload,want", [
+    ({}, 400),
+    ({"assignment": {"version": 1, "partitions": []}}, 400),  # no brokers
+    ({"assignment": "nope", "brokers": "0-3"}, 400),
+    ({"assignment": {"version": 1, "partitions": []}, "brokers": "x"}, 400),
+    ({"assignment": demo_assignment().to_dict(), "brokers": "0-18",
+      "rf": "three"}, 400),
+    ({"assignment": demo_assignment().to_dict(), "brokers": "0-18",
+      "solver": "unknown-backend"}, 400),
+    ({"assignment": demo_assignment().to_dict(), "brokers": "0,1",
+      "rf": 5}, 422),  # RF > broker count
+])
+def test_submit_error_paths(server_url, payload, want):
+    status, body = post(server_url, payload)
+    assert status == want, body
+    assert "error" in body
+
+
+def test_submit_rejects_invalid_json(server_url):
+    req = urllib.request.Request(
+        server_url + "/submit", data=b"{not json", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 400
+
+
+def test_healthz_and_404(server_url):
+    with urllib.request.urlopen(server_url + "/healthz", timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body["status"] == "ok"
+    assert "milp" in body["solvers"] and "tpu" in body["solvers"]
+    try:
+        urllib.request.urlopen(server_url + "/nope", timeout=30)
+        status = 200
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 404
+
+
+def test_handler_unit_surface():
+    """handle_submit is callable without a socket (embedding surface)."""
+    out = handle_submit({
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "milp",
+    })
+    assert out["report"]["replica_moves"] == 1
+    with pytest.raises(ApiError) as ei:
+        handle_submit({"brokers": "0-3"})
+    assert ei.value.status == 400
