@@ -29,6 +29,9 @@ import time
 def run_scenario(
     name: str, smoke: bool = False, seed: int = 0, warm: bool = False
 ) -> dict:
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
     from kafka_assignment_optimizer_tpu.api import optimize
     from kafka_assignment_optimizer_tpu.utils import gen
 

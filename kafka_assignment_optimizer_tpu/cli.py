@@ -74,6 +74,9 @@ def load_topology(spec: str | None, broker_ids: list[int]) -> Topology | None:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .utils.platform import pin_platform
+
+    pin_platform()
     try:
         return _run(build_parser().parse_args(argv))
     except (ValueError, KeyError, FileNotFoundError, RuntimeError, OSError) as e:

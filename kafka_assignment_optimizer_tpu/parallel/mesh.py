@@ -106,14 +106,21 @@ def solve_on_mesh(
     t_hi: float = 2.5,
     t_lo: float = 0.05,
 ):
-    """Run the annealer sharded over `mesh`; returns (best_a [P, R],
-    best_key scalar) after a host-side reduce over shards."""
+    """Run the annealer sharded over `mesh`; returns the per-shard winners
+    ``(best_a [n_dev, P, R], best_k [n_dev])`` as device arrays — the
+    engine re-scores this final population (Pallas kernel on TPU) and
+    polishes the champion."""
     n_dev = mesh.devices.size
     fn = _compiled_solver(
         mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo
     )
     keys = jax.random.split(key, n_dev)
-    best_a, best_k = fn(m, a_seed, keys)
+    return fn(m, a_seed, keys)
+
+
+def best_of(best_a, best_k):
+    """Host-side argmax over the per-shard winners (the final cross-shard
+    reduce — a few KB)."""
     best_a, best_k = jax.device_get((best_a, best_k))
     top = int(np.argmax(best_k))
     return best_a[top], int(best_k[top])

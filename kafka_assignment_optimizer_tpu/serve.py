@@ -210,6 +210,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--port", type=int, default=8787)
     ap.add_argument("--verbose", action="store_true", help="access logs")
     args = ap.parse_args(argv)
+    from .utils.platform import pin_platform
+
+    pin_platform()
     srv = make_server(args.host, args.port, verbose=args.verbose)
     print(f"listening on http://{args.host}:{srv.server_address[1]}", file=sys.stderr)
     try:

@@ -1,0 +1,24 @@
+"""Platform pinning: make ``JAX_PLATFORMS`` authoritative.
+
+Site plugins can force-register an accelerator platform and win over the
+environment variable (tests/conftest.py documents the same issue for the
+CPU test mesh). Entry points (CLI, HTTP service, bench) call
+:func:`pin_platform` before any JAX backend initializes so an operator's
+``JAX_PLATFORMS=cpu`` (or ``tpu``) is always honored.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform(platform: str | None = None) -> None:
+    """Pin JAX to ``platform`` (default: the ``JAX_PLATFORMS`` env var).
+    No-op when neither is set. Must run before backend initialization."""
+    want = platform or os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)

@@ -1,0 +1,104 @@
+"""Steepest-descent polish tests: every applied move must track the exact
+numpy oracle (score never decreases, final state exactly rescored), and a
+polished candidate must be 1-move locally optimal — no single replacement
+or leader swap can improve it (verified by brute force)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance, optimize
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+from kafka_assignment_optimizer_tpu.solvers.tpu.arrays import LAMBDA, SCALE_W
+from kafka_assignment_optimizer_tpu.solvers.tpu.polish import polish_jit
+
+from tests.test_tpu_engine import random_cluster
+
+
+def exact_score(inst, a):
+    v = inst.violations(a)
+    pen = (v["broker_balance"] + v["leader_balance"] + v["rack_balance"]
+           + v["part_rack_diversity"])
+    return SCALE_W * inst.preservation_weight(a) - LAMBDA * pen
+
+
+def brute_force_best_single_move(inst, a):
+    """Max exact-score gain over ALL single moves (replace + lswap)."""
+    P, R = a.shape
+    B = inst.num_brokers
+    base = exact_score(inst, a)
+    best = 0
+    for p in range(P):
+        rf = int(inst.rf[p])
+        row = set(int(x) for x in a[p, :rf])
+        for s in range(rf):
+            for b in range(B):
+                if b in row:
+                    continue
+                cand = a.copy()
+                cand[p, s] = b
+                best = max(best, exact_score(inst, cand) - base)
+        for s in range(1, rf):
+            cand = a.copy()
+            cand[p, 0], cand[p, s] = cand[p, s], cand[p, 0]
+            best = max(best, exact_score(inst, cand) - base)
+    return best
+
+
+@pytest.mark.parametrize("case", [
+    dict(n_brokers=8, n_parts=10, rf=2, n_racks=2, drop=1),
+    dict(n_brokers=9, n_parts=8, rf=3, n_racks=3, drop=0),
+    dict(n_brokers=10, n_parts=9, rf=1, n_racks=2, drop=2),  # RF=1 edge
+])
+def test_polish_reaches_local_optimum(case, rng):
+    current, brokers, topo = random_cluster(rng, **case)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    for trial in range(3):
+        a0 = rng.integers(0, inst.num_brokers, size=inst.a0.shape).astype(np.int32)
+        # de-duplicate rows so a0 is a legal candidate (hard constraint C8)
+        for p in range(inst.num_parts):
+            rf = int(inst.rf[p])
+            seen, pool = set(), [b for b in range(inst.num_brokers)]
+            for s in range(rf):
+                b = int(a0[p, s])
+                if b in seen:
+                    b = next(x for x in pool if x not in seen)
+                a0[p, s] = b
+                seen.add(b)
+        out = np.asarray(polish_jit(m, jnp.asarray(a0)))
+        # never worse, duplicates never introduced
+        assert exact_score(inst, out) >= exact_score(inst, a0)
+        v = inst.violations(out)
+        assert v["duplicate_in_partition"] == 0 and v["null_in_valid_slot"] == 0
+        # 1-move local optimality, brute-forced
+        assert brute_force_best_single_move(inst, out) <= 0
+
+
+def test_polish_fixes_single_bad_slot(demo):
+    """Start from the known optimum with one slot vandalized; polish alone
+    must restore an optimal-score plan (the demo's 1-move structure)."""
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+    a = greedy_seed(inst)
+    assert inst.move_count(a) == 1
+    best = exact_score(inst, a)
+    vandal = a.copy()
+    vandal[4, 1] = (vandal[4, 1] + 4) % inst.num_brokers
+    if vandal[4, 1] == vandal[4, 0]:
+        vandal[4, 1] = (vandal[4, 1] + 1) % inst.num_brokers
+    out = np.asarray(polish_jit(m := arrays.from_instance(inst), jnp.asarray(vandal)))
+    assert exact_score(inst, out) >= best
+    assert inst.move_count(out) <= 2
+
+
+def test_engine_with_polish_still_golden(demo):
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="tpu",
+                   batch=16, rounds=4, steps_per_round=150)
+    rep = res.report()
+    assert rep["feasible"], rep
+    assert res.replica_moves == 1
+    assert res.solve.objective == res.instance.max_weight()
