@@ -53,6 +53,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--seed", type=int, default=0, help="search RNG seed")
     ap.add_argument("--batch", type=int, help="candidates per device (tpu solver)")
     ap.add_argument("--sweeps", type=int, help="annealing outer iterations (tpu solver)")
+    ap.add_argument(
+        "--engine",
+        choices=["chain", "sweep"],
+        help="tpu solver inner engine: per-move Metropolis chains (small "
+        "instances) or sweep-parallel proposals (default above "
+        "512 partitions)",
+    )
     ap.add_argument("--time-limit", type=float, help="solver time limit seconds")
     ap.add_argument(
         "--emit-lp",
@@ -102,6 +109,8 @@ def _run(args: argparse.Namespace) -> int:
         kw["batch"] = args.batch
     if args.sweeps:
         kw["sweeps"] = args.sweeps
+    if args.engine:
+        kw["engine"] = args.engine
     if args.time_limit:
         kw["time_limit_s"] = args.time_limit
 

@@ -54,12 +54,12 @@ def _compiled_solver(
     steps_per_round: int,
     t_hi: float,
     t_lo: float,
+    engine: str = "chain",
 ):
-    from ..solvers.tpu.anneal import make_solver_fn
-
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
         chains_per_device, rounds, steps_per_round, float(t_hi), float(t_lo),
+        engine,
     )
     fn = _COMPILED.get(cache_key)
     if fn is not None:  # LRU refresh: insertion order tracks recency
@@ -70,14 +70,30 @@ def _compiled_solver(
         # shard_map introduces the mesh axis even for a single device, so
         # the solver always anneals with axis_name set here (collectives
         # over a singleton axis are free)
-        solve = make_solver_fn(
-            chains_per_device,
-            rounds,
-            steps_per_round,
-            t_hi=t_hi,
-            t_lo=t_lo,
-            axis_name=AXIS,
-        )
+        if engine == "sweep":
+            from ..solvers.tpu.sweep import make_sweep_solver_fn
+
+            # rounds * steps_per_round is the step budget per chain in the
+            # chain engine; the sweep engine's sequential budget is just
+            # `rounds` sweeps (each sweep touches every partition)
+            solve = make_sweep_solver_fn(
+                chains_per_device,
+                sweeps=rounds,
+                t_hi=t_hi,
+                t_lo=t_lo,
+                axis_name=AXIS,
+            )
+        else:
+            from ..solvers.tpu.anneal import make_solver_fn
+
+            solve = make_solver_fn(
+                chains_per_device,
+                rounds,
+                steps_per_round,
+                t_hi=t_hi,
+                t_lo=t_lo,
+                axis_name=AXIS,
+            )
 
         def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array, keys: jax.Array):
             best_a, best_k = solve(m_rep, seed_rep, keys[0])
@@ -105,6 +121,7 @@ def solve_on_mesh(
     steps_per_round: int,
     t_hi: float = 2.5,
     t_lo: float = 0.05,
+    engine: str = "chain",
 ):
     """Run the annealer sharded over `mesh`; returns the per-shard winners
     ``(best_a [n_dev, P, R], best_k [n_dev])`` as device arrays — the
@@ -112,7 +129,7 @@ def solve_on_mesh(
     polishes the champion."""
     n_dev = mesh.devices.size
     fn = _compiled_solver(
-        mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo
+        mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo, engine
     )
     keys = jax.random.split(key, n_dev)
     return fn(m, a_seed, keys)

@@ -30,7 +30,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax, random
 
-from .arrays import LAMBDA, SCALE_W, ModelArrays
+from .arrays import (
+    LAMBDA,
+    SCALE_W,
+    ModelArrays,
+    band_pen as _shared_band_pen,
+    geometric_temps,
+    u01 as _shared_u01,
+)
 
 # move-type proposal mix
 P_REPLACE = 0.45
@@ -83,8 +90,7 @@ def init_chain(m: ModelArrays, a_seed: jax.Array, key: jax.Array) -> ChainState:
     )
 
 
-def _band_pen(c: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
-    return jnp.maximum(c - hi, 0) + jnp.maximum(lo - c, 0)
+_band_pen = _shared_band_pen
 
 
 def _delta_band(c_from, c_to, lo, hi):
@@ -97,11 +103,7 @@ def _delta_band(c_from, c_to, lo, hi):
     )
 
 
-def _u01(bits: jax.Array) -> jax.Array:
-    """uint32 -> uniform float32 in [0, 1) via the top 24 bits."""
-    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
-        1.0 / (1 << 24)
-    )
+_u01 = _shared_u01
 
 
 def _anneal_step(
@@ -376,10 +378,7 @@ def make_solver_fn(
     runtime argument, so jitting the returned function once covers every
     instance of the same shape (warm re-solves skip compilation)."""
     run_round = make_round_runner(steps_per_round, axis_name)
-    temps = jnp.asarray(
-        t_hi * (t_lo / t_hi) ** (jnp.arange(rounds) / max(rounds - 1, 1)),
-        jnp.float32,
-    )
+    temps = geometric_temps(t_hi, t_lo, rounds)
 
     def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
         keys = random.split(key, n_chains)

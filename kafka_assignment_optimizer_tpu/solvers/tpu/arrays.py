@@ -27,6 +27,27 @@ SCALE_W = 1
 LAMBDA = 64
 
 
+def band_pen(c, lo, hi):
+    """Integer band-violation magnitude of count ``c`` vs [lo, hi] —
+    shared by both annealing engines' accept decisions; must match the
+    numpy oracle (``ProblemInstance.violations``) exactly."""
+    return jnp.maximum(c - hi, 0) + jnp.maximum(lo - c, 0)
+
+
+def u01(bits):
+    """uint32 -> uniform float32 in [0, 1) via the top 24 bits."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24)
+    )
+
+
+def geometric_temps(t_hi: float, t_lo: float, n: int) -> jax.Array:
+    """The shared annealing temperature ladder."""
+    return jnp.asarray(
+        t_hi * (t_lo / t_hi) ** (jnp.arange(n) / max(n - 1, 1)), jnp.float32
+    )
+
+
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
 class ModelArrays:

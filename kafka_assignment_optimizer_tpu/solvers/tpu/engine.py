@@ -26,12 +26,35 @@ from . import arrays
 from .seed import greedy_seed
 
 
-def _defaults(inst: ProblemInstance, platform: str) -> dict:
-    """Search-effort defaults: scale chains with the hardware, steps with
-    the problem. CPU (CI) stays small; TPU uses the full batch."""
+# partition count at which the sweep-parallel engine takes over from the
+# per-move Metropolis chains: above this, sequential chain steps dominate
+# wall-clock (one move per step), while a sweep applies up to min(P, B)
+# moves per fused step
+_SWEEP_THRESHOLD_PARTS = 512
+
+
+def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
+    """Search-effort defaults for the RESOLVED engine: scale chains with
+    the hardware, steps with the problem. CPU (CI) stays small; TPU uses
+    the full batch. The engine must be resolved first — each engine's
+    budget is meaningless for the other (a chain budget of 256 sweeps
+    would leave the chain engine 1000x under-searched and vice versa)."""
     P = inst.num_parts
     on_tpu = platform == "tpu"
+    engine = engine or (
+        "sweep" if P >= _SWEEP_THRESHOLD_PARTS else "chain"
+    )
+    if engine == "sweep":
+        # sweep engine: sequential depth is `rounds` sweeps, flat in P;
+        # chain count trades against per-sweep cost (O(chains * P))
+        return {
+            "engine": "sweep",
+            "batch": max(8, min(256, (1 << 21) // max(P, 1))) if on_tpu else 8,
+            "rounds": 256 if on_tpu else 64,
+            "steps_per_round": 1,
+        }
     return {
+        "engine": "chain",
         "batch": 512 if on_tpu else 32,
         "rounds": 24,
         "steps_per_round": max(256, min(4 * P, 20_000)),
@@ -46,17 +69,27 @@ def solve_tpu(
     rounds: int | None = None,
     sweeps: int | None = None,  # CLI alias for rounds
     steps_per_round: int | None = None,
-    t_hi: float = 2.5,
-    t_lo: float = 0.05,
+    t_hi: float | None = None,
+    t_lo: float | None = None,
     n_devices: int | None = None,
+    engine: str | None = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
     platform = jax.devices()[0].platform
-    d = _defaults(inst, platform)
+    d = _defaults(inst, platform, engine)
+    engine = d["engine"]
     batch = batch or d["batch"]
     rounds = rounds or sweeps or d["rounds"]
     steps_per_round = steps_per_round or d["steps_per_round"]
+    if engine == "sweep":
+        # the sweep engine has no inner step loop: its sequential budget
+        # is `rounds` sweeps, each touching every partition once
+        steps_per_round = 1
+    if t_hi is None:
+        t_hi = 2.0 if engine == "sweep" else 2.5
+    if t_lo is None:
+        t_lo = 0.02 if engine == "sweep" else 0.05
 
     # host-side greedy repair: near-feasible, near-min-move warm start
     a_seed = greedy_seed(inst)
@@ -85,6 +118,7 @@ def solve_tpu(
         steps_per_round,
         t_hi=t_hi,
         t_lo=t_lo,
+        engine=engine,
     )
     t_solve = time.perf_counter()
 
@@ -122,11 +156,16 @@ def solve_tpu(
         optimal=False,
         stats={
             "platform": platform,
+            "engine": engine,
             "devices": n_dev,
             "chains_per_device": chains_per_device,
             "rounds": rounds,
             "steps_per_round": steps_per_round,
-            "total_steps": rounds * steps_per_round,
+            # chain: Metropolis steps per chain; sweep: every sweep
+            # proposes one move per partition
+            "total_steps": rounds * steps_per_round
+            if engine == "chain"
+            else rounds * inst.num_parts,
             "seed_s": round(t_seed - t0, 4),
             "anneal_s": round(t_solve - t_seed, 4),
             "polish_s": round(t_polish - t_solve, 4),

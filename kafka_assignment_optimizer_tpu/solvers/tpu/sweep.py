@@ -1,0 +1,457 @@
+"""Sweep-parallel annealing: the large-instance TPU engine.
+
+The chain engine (``.anneal``) applies ONE Metropolis move per sequential
+step — O(RF) work per step. That is the right shape for a CPU and a fine
+shape for small clusters, but at 10k partitions it needs hundreds of
+thousands of *sequential* device steps, and a TPU spends the whole solve
+latency-bound at ~0% utilization (the scaling wall SURVEY.md §3.1 notes
+for lp_solve, reborn as a dispatch wall).
+
+This engine restructures the loop so per-step work scales with the
+problem: every sweep proposes ONE move for EVERY partition of every chain
+simultaneously ([N, P] proposals), evaluates all proposal deltas against
+the sweep-start histograms as dense gather algebra, Metropolis-accepts
+per partition, then **conflict-thins** the accepted set so at most one
+move touches any broker's in/out counts (random-priority scatter-max) —
+bounding histogram drift to ±1 per broker per sweep while still applying
+up to min(P, B) moves in parallel. Histograms and exact scores are
+recomputed from scratch each sweep (O(N·P·R) fused dense work — there is
+no incremental bookkeeping to corrupt, and the recompute costs less than
+one HBM pass over the population).
+
+Sequential depth collapses from O(P · sweeps) to O(sweeps): ~300 fused
+steps regardless of cluster size. Feasibility and final quality are
+enforced downstream (engine: exact rescore + steepest-descent polish +
+numpy verification), so the sweep loop is free to be an optimizer, not a
+bookkeeper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+
+from .arrays import (
+    LAMBDA,
+    SCALE_W,
+    ModelArrays,
+    band_pen as _band_pen,
+    geometric_temps,
+    u01 as _u01,
+)
+
+P_LSWAP = 0.15  # leadership-only proposals (zero replica movement)
+P_RESTORE = 0.5  # replace proposals that re-propose the original broker
+
+
+def _histograms(m: ModelArrays, a: jax.Array):
+    """Exact per-chain histograms. a: [N, P, R] -> cnt/lcnt [N, B+1],
+    rcnt [N, K+1]."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    K1 = m.rack_lo.shape[0]
+    n_idx = jnp.arange(N)[:, None, None]
+    flat = jnp.where(m.slot_valid[None], a, B)
+    cnt = jnp.zeros((N, B + 1), jnp.int32).at[
+        jnp.broadcast_to(n_idx, a.shape), flat
+    ].add(1)
+    lcnt = jnp.zeros((N, B + 1), jnp.int32).at[
+        jnp.arange(N)[:, None], flat[:, :, 0]
+    ].add(1)
+    racks = m.rack_of[flat]  # [N, P, R]
+    rcnt = jnp.zeros((N, K1), jnp.int32).at[
+        jnp.broadcast_to(n_idx, a.shape), racks
+    ].add(1)
+    return flat, racks, cnt, lcnt, rcnt
+
+
+def _div_overflow(m: ModelArrays, racks: jax.Array) -> jax.Array:
+    """C10 penalty without a [N, P, K] table: a slot overflows when its
+    within-partition same-rack rank reaches the cap. O(N·P·R²)."""
+    R = racks.shape[-1]
+    same = racks[..., :, None] == racks[..., None, :]  # [N, P, R, R]
+    tri = (jnp.arange(R)[:, None] > jnp.arange(R)[None, :])[None, None]
+    rank = (same & tri).sum(-1)  # [N, P, R]
+    over = jnp.logical_and(
+        m.slot_valid[None], rank >= m.part_rack_hi[None, :, None]
+    )
+    return over.sum((1, 2)).astype(jnp.int32)  # [N]
+
+
+def _weight(m: ModelArrays, a: jax.Array) -> jax.Array:
+    """Exact preservation weight per chain. [N]."""
+    N, P, R = a.shape
+    p_idx = jnp.arange(P)[None, :, None]
+    wl = m.w_lead[p_idx[..., 0], a[:, :, 0]]  # [N, P]
+    w = jnp.where(m.slot_valid[None, :, 0], wl, 0).sum(1)
+    if R > 1:
+        wf = m.w_foll[jnp.broadcast_to(p_idx, a[..., 1:].shape), a[:, :, 1:]]
+        w = w + jnp.where(m.slot_valid[None, :, 1:], wf, 0).sum((1, 2))
+    return w.astype(jnp.int32)
+
+
+def chain_scores(m: ModelArrays, a: jax.Array):
+    """(weight [N], penalty [N]) — exact, from scratch."""
+    flat, racks, cnt, lcnt, rcnt = _histograms(m, a)
+    B = m.num_brokers
+    K = m.num_racks
+    pen = (
+        _band_pen(cnt[:, :B], m.broker_band[0], m.broker_band[1]).sum(1)
+        + _band_pen(lcnt[:, :B], m.leader_band[0], m.leader_band[1]).sum(1)
+        + _band_pen(rcnt[:, :K], m.rack_lo[None, :K], m.rack_hi[None, :K]).sum(1)
+        + _div_overflow(m, racks)
+    ).astype(jnp.int32)
+    return _weight(m, a), pen
+
+
+def best_key(w: jax.Array, pen: jax.Array) -> jax.Array:
+    return jnp.where(pen == 0, w, -pen - 1)
+
+
+def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
+    """One parallel annealing sweep over all chains and partitions."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    i32 = jnp.int32
+    u32 = jnp.uint32
+
+    flat, racks, cnt, lcnt, rcnt = _histograms(m, a)
+    bits = random.bits(key, (N, P, 6), jnp.uint32)
+    rf = m.rf[None, :]  # [1, P]
+
+    # ---- proposal: slot + move type + incoming broker ----------------
+    s_rep = (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % rf
+    s_lsw = 1 + (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % jnp.maximum(
+        rf - 1, 1
+    )
+    is_lsw = jnp.logical_and(_u01(bits[..., 1]) < P_LSWAP, rf > 1)
+    s = jnp.where(is_lsw, s_lsw, s_rep)  # [N, P]
+
+    p_idx = jnp.arange(P)[None, :]
+    n_idx = jnp.arange(N)[:, None]
+    b_old = a[n_idx, p_idx, jnp.where(is_lsw, 0, s)]  # replace: slot s;
+    # lswap: the leader loses leadership — model as (b_out, b_in) on lcnt
+    b_foll = a[n_idx, p_idx, s]  # lswap promotee (== b_old for replace? no)
+
+    b_uni = (bits[..., 2] % u32(B)).astype(i32)
+    s_orig = (bits[..., 3] & u32(0xFFFF)).astype(i32) % R
+    b_orig = m.a0[jnp.broadcast_to(p_idx, s_orig.shape), s_orig]  # [N, P]
+    b_new = jnp.where(
+        jnp.logical_and(_u01(bits[..., 3]) < P_RESTORE, b_orig < B),
+        b_orig,
+        b_uni,
+    )
+
+    # ---- deltas (replace: a[p, s] <- b_new) --------------------------
+    lead_slot = s == 0
+    wl_new = m.w_lead[p_idx, b_new]
+    wf_new = m.w_foll[p_idx, b_new]
+    wl_old = m.w_lead[p_idx, b_old]
+    wf_old = m.w_foll[p_idx, b_old]
+    dw_rep = jnp.where(lead_slot, wl_new - wl_old, wf_new - wf_old)
+
+    blo, bhi = m.broker_band[0], m.broker_band[1]
+    llo, lhi = m.leader_band[0], m.leader_band[1]
+    cnt_old = cnt[n_idx, b_old]
+    cnt_new = cnt[n_idx, b_new]
+    d_cnt = (
+        _band_pen(cnt_old - 1, blo, bhi) - _band_pen(cnt_old, blo, bhi)
+        + _band_pen(cnt_new + 1, blo, bhi) - _band_pen(cnt_new, blo, bhi)
+    )
+    lcnt_old = lcnt[n_idx, b_old]
+    lcnt_new = lcnt[n_idx, b_new]
+    d_lcnt_rep = jnp.where(
+        lead_slot,
+        _band_pen(lcnt_old - 1, llo, lhi) - _band_pen(lcnt_old, llo, lhi)
+        + _band_pen(lcnt_new + 1, llo, lhi) - _band_pen(lcnt_new, llo, lhi),
+        0,
+    )
+    r_old = m.rack_of[b_old]
+    r_new = m.rack_of[b_new]
+    rc_old = rcnt[n_idx, r_old]
+    rc_new = rcnt[n_idx, r_new]
+    d_rcnt = (
+        _band_pen(rc_old - 1, m.rack_lo[r_old], m.rack_hi[r_old])
+        - _band_pen(rc_old, m.rack_lo[r_old], m.rack_hi[r_old])
+        + _band_pen(rc_new + 1, m.rack_lo[r_new], m.rack_hi[r_new])
+        - _band_pen(rc_new, m.rack_lo[r_new], m.rack_hi[r_new])
+    )
+    # diversity: within-partition rack counts for the two racks involved
+    c_old = (racks == r_old[:, :, None]).sum(-1)
+    c_new = (racks == r_new[:, :, None]).sum(-1)
+    cap = m.part_rack_hi[None, :]
+
+    def g(c):
+        return jnp.maximum(c - cap, 0)
+
+    d_div = g(c_old - 1) - g(c_old) + g(c_new + 1) - g(c_new)
+    cross_rack = r_old != r_new
+    dpen_rep = d_cnt + d_lcnt_rep + jnp.where(cross_rack, d_rcnt + d_div, 0)
+    # b_old == b_new (or b_new already in the row) is illegal
+    in_row = jnp.logical_and(
+        flat == b_new[:, :, None], m.slot_valid[None]
+    ).any(-1)
+    legal_rep = ~in_row
+
+    # ---- deltas (lswap: promote slot s to leader) --------------------
+    b_lead = a[n_idx, p_idx, 0]
+    dw_lsw = (
+        m.w_lead[p_idx, b_foll] + m.w_foll[p_idx, b_lead]
+        - m.w_lead[p_idx, b_lead] - m.w_foll[p_idx, b_foll]
+    )
+    lc_l = lcnt[n_idx, b_lead]
+    lc_f = lcnt[n_idx, b_foll]
+    dpen_lsw = (
+        _band_pen(lc_l - 1, llo, lhi) - _band_pen(lc_l, llo, lhi)
+        + _band_pen(lc_f + 1, llo, lhi) - _band_pen(lc_f, llo, lhi)
+    )
+
+    dw = jnp.where(is_lsw, dw_lsw, dw_rep)
+    dpen = jnp.where(is_lsw, dpen_lsw, dpen_rep)
+    legal = jnp.where(is_lsw, rf > 1, legal_rep)
+    delta = (SCALE_W * dw - LAMBDA * dpen).astype(jnp.float32)
+
+    # ---- Metropolis accept -------------------------------------------
+    accept = jnp.logical_and(
+        legal,
+        jnp.logical_or(
+            delta >= 0,
+            _u01(bits[..., 4]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+        ),
+    )
+
+    # ---- conflict thinning: ≤1 accepted move per broker's counts -----
+    # tokens: replace moves an (out=b_old, in=b_new) unit; lswap moves a
+    # leadership unit (out=b_lead, in=b_foll). One shared priority map per
+    # direction bounds every histogram's drift to ±1 per broker per sweep.
+    prio = _u01(bits[..., 5]) + jnp.float32(1e-6)  # > 0
+    prio = jnp.where(accept, prio, 0.0)
+    tok_out = jnp.where(is_lsw, b_lead, b_old)
+    tok_in = jnp.where(is_lsw, b_foll, b_new)
+    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_out].max(prio)
+    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_in].max(prio)
+    keep = jnp.logical_and(
+        accept,
+        jnp.logical_and(
+            prio == m_out[n_idx, tok_out], prio == m_in[n_idx, tok_in]
+        ),
+    )
+
+    # ---- apply (vectorized; one move max per partition) --------------
+    r_iota = jnp.arange(R)[None, None, :]
+    s3 = s[:, :, None]
+    keep3 = keep[:, :, None]
+    # replace: slot s <- b_new
+    rep_val = jnp.where(r_iota == s3, b_new[:, :, None], a)
+    # lswap: slot 0 <- b_foll, slot s <- b_lead
+    lsw_val = jnp.where(
+        r_iota == 0,
+        b_foll[:, :, None],
+        jnp.where(r_iota == s3, b_lead[:, :, None], a),
+    )
+    new_a = jnp.where(is_lsw[:, :, None], lsw_val, rep_val)
+    return jnp.where(keep3, new_a, a)
+
+
+def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
+    """Cross-partition replica exchange — the count-invariant move.
+
+    Under exact-equality bands (lo == hi on broker/rack totals, common
+    when sizes divide evenly) single-site replaces always pass through a
+    penalized state and freeze out at low temperature; redistribution
+    then needs swaps that leave every per-broker and per-rack total
+    untouched (the chain engine's ``xswap``). Parallel form: a fresh
+    random permutation pairs the partitions each sweep — every partition
+    belongs to exactly ONE pair, so pair moves are conflict-free by
+    construction — and each pair proposes swapping one replica slot.
+    Only leader-count and per-partition diversity penalties can change;
+    both are evaluated exactly within the pair.
+    """
+    N, P, R = a.shape
+    B = m.num_brokers
+    i32 = jnp.int32
+    u32 = jnp.uint32
+    H = P // 2
+    if H == 0:
+        return a
+
+    kperm, kbits = random.split(key)
+    perm = random.permutation(kperm, P)
+    u = perm[:H]  # [H] first of each pair
+    v = perm[H : 2 * H]
+    bits = random.bits(kbits, (N, H, 4), jnp.uint32)
+
+    flat = jnp.where(m.slot_valid[None], a, B)
+    n_idx = jnp.arange(N)[:, None]
+    rf_u = m.rf[u][None, :]  # [1, H]
+    rf_v = m.rf[v][None, :]
+    su = (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % rf_u
+    sv = (bits[..., 1] & u32(0x3FFFFFFF)).astype(i32) % rf_v
+    u2 = jnp.broadcast_to(u[None, :], su.shape)
+    v2 = jnp.broadcast_to(v[None, :], sv.shape)
+    b_u = a[n_idx, u2, su]  # [N, H]
+    b_v = a[n_idx, v2, sv]
+
+    # legality: the incoming broker must not already sit in the row
+    in_u = jnp.logical_and(flat[n_idx, u2] == b_v[..., None],
+                           m.slot_valid[u][None]).any(-1)
+    in_v = jnp.logical_and(flat[n_idx, v2] == b_u[..., None],
+                           m.slot_valid[v][None]).any(-1)
+    legal = ~jnp.logical_or(in_u, in_v)
+
+    # objective delta (role-aware at both sites)
+    lead_u = su == 0
+    lead_v = sv == 0
+
+    def role_w(p2, b, lead):
+        return jnp.where(lead, m.w_lead[p2, b], m.w_foll[p2, b])
+
+    dw = (
+        role_w(u2, b_v, lead_u) - role_w(u2, b_u, lead_u)
+        + role_w(v2, b_u, lead_v) - role_w(v2, b_v, lead_v)
+    )
+
+    # leader-count delta only when exactly one slot is a leader slot
+    llo, lhi = m.leader_band[0], m.leader_band[1]
+    lcnt = jnp.zeros((N, B + 1), jnp.int32).at[
+        jnp.arange(N)[:, None], flat[:, :, 0]
+    ].add(1)
+    l_out = jnp.where(lead_u, b_u, b_v)
+    l_in = jnp.where(lead_u, b_v, b_u)
+    xor = jnp.logical_xor(lead_u, lead_v)
+    lo_c = lcnt[n_idx, l_out]
+    li_c = lcnt[n_idx, l_in]
+    d_lcnt = jnp.where(
+        xor,
+        _band_pen(lo_c - 1, llo, lhi) - _band_pen(lo_c, llo, lhi)
+        + _band_pen(li_c + 1, llo, lhi) - _band_pen(li_c, llo, lhi),
+        0,
+    )
+
+    # per-partition diversity deltas at both sites
+    racks = m.rack_of[flat]
+    r_bu = m.rack_of[b_u]
+    r_bv = m.rack_of[b_v]
+    cross = r_bu != r_bv
+
+    def div_delta(p2, r_out, r_in):
+        rk = racks[n_idx, p2]  # [N, H, R]
+        c_out = (rk == r_out[..., None]).sum(-1)
+        c_in = (rk == r_in[..., None]).sum(-1)
+        cap = m.part_rack_hi[p2]
+
+        def g(c):
+            return jnp.maximum(c - cap, 0)
+
+        return g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in)
+
+    d_div = jnp.where(
+        cross, div_delta(u2, r_bu, r_bv) + div_delta(v2, r_bv, r_bu), 0
+    )
+
+    delta = (SCALE_W * dw - LAMBDA * (d_lcnt + d_div)).astype(jnp.float32)
+    accept = jnp.logical_and(
+        legal,
+        jnp.logical_or(
+            delta >= 0,
+            _u01(bits[..., 2]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+        ),
+    )
+
+    # thinning only for the leader-count tokens (pairs are otherwise
+    # independent); token B (null) bypasses the map
+    prio = _u01(bits[..., 3]) + jnp.float32(1e-6)
+    prio = jnp.where(jnp.logical_and(accept, xor), prio, 0.0)
+    t_out = jnp.where(xor, l_out, B)
+    t_in = jnp.where(xor, l_in, B)
+    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, t_out].max(prio)
+    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, t_in].max(prio)
+    win = jnp.logical_and(
+        jnp.logical_or(t_out == B, prio == m_out[n_idx, t_out]),
+        jnp.logical_or(t_in == B, prio == m_in[n_idx, t_in]),
+    )
+    keep = jnp.logical_and(accept, win)
+
+    # apply: each partition is in exactly one pair, so the two scatters
+    # never collide; rejected pairs rewrite their current values
+    new_u = jnp.where(keep, b_v, b_u)
+    new_v = jnp.where(keep, b_u, b_v)
+    a = a.at[n_idx, u2, su].set(new_u)
+    a = a.at[n_idx, v2, sv].set(new_v)
+    return a
+
+
+def make_sweep_solver_fn(
+    n_chains: int,
+    sweeps: int,
+    t_hi: float = 2.0,
+    t_lo: float = 0.02,
+    snapshot_every: int = 8,
+    axis_name: str | None = None,
+):
+    """Build the jittable (m, a_seed [P, R], key) -> (best_a [P, R],
+    best_key scalar) sweep-parallel solver for one shard. Interface
+    matches ``anneal.make_solver_fn`` so ``parallel.mesh`` can host
+    either engine."""
+    temps = geometric_temps(t_hi, t_lo, sweeps)
+
+    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
+        P, R = a_seed.shape
+        a = jnp.broadcast_to(a_seed.astype(jnp.int32), (n_chains, P, R))
+        w0, p0 = chain_scores(m, a)
+        best_k = best_key(w0, p0)  # seed snapshot: never return worse
+        best_a = a
+
+        if axis_name is not None:
+            def to_varying(x):
+                if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+                    return x
+                return lax.pcast(x, axis_name, to="varying")
+
+            key = to_varying(key)
+            a, best_k, best_a = jax.tree.map(to_varying, (a, best_k, best_a))
+
+        def body(carry, xs):
+            a, best_k, best_a, key = carry
+            temp, do_snap, do_exchange = xs
+            key, sub = random.split(key)
+            a = lax.cond(
+                do_exchange,
+                lambda a: exchange_sweep(m, a, sub, temp),
+                lambda a: sweep_once(m, a, sub, temp),
+                a,
+            )
+
+            def snap(args):
+                a, best_k, best_a = args
+                w, pen = chain_scores(m, a)
+                k = best_key(w, pen)
+                improved = k > best_k
+                return (
+                    jnp.where(improved, k, best_k),
+                    jnp.where(improved[:, None, None], a, best_a),
+                )
+
+            best_k, best_a = lax.cond(
+                do_snap, snap, lambda args: (args[1], args[2]),
+                (a, best_k, best_a),
+            )
+            return (a, best_k, best_a, key), None
+
+        # snapshot every Nth sweep AND the final one: the coldest sweeps
+        # improve the most and must never be discarded
+        idx = jnp.arange(sweeps)
+        do_snap = jnp.logical_or(
+            idx % snapshot_every == snapshot_every - 1, idx == sweeps - 1
+        )
+        # odd sweeps run the count-invariant pair-exchange move; even
+        # sweeps run single-site replace/lswap proposals
+        do_exchange = jnp.arange(sweeps) % 2 == 1
+        (a, best_k, best_a, key), _ = lax.scan(
+            body, (a, best_k, best_a, key), (temps, do_snap, do_exchange)
+        )
+        top = jnp.argmax(best_k)
+        return best_a[top], best_k[top]
+
+    return solve
