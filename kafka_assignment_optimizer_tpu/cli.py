@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--time-limit", type=float, help="solver time limit seconds")
     ap.add_argument(
+        "--checkpoint",
+        metavar="PATH",
+        help="warm-start from / save the best plan to this .npz (tpu solver); "
+        "re-solves of the same instance never regress below it",
+    )
+    ap.add_argument(
+        "--profile-dir",
+        metavar="DIR",
+        help="write a jax.profiler trace of the solve loop here (tpu solver)",
+    )
+    ap.add_argument(
         "--emit-lp",
         metavar="PATH",
         help="also write the lp_solve LP-format equation file (README.md:144-185)",
@@ -111,6 +122,10 @@ def _run(args: argparse.Namespace) -> int:
         kw["sweeps"] = args.sweeps
     if args.engine:
         kw["engine"] = args.engine
+    if args.checkpoint:
+        kw["checkpoint"] = args.checkpoint
+    if args.profile_dir:
+        kw["profile_dir"] = args.profile_dir
     if args.time_limit:
         kw["time_limit_s"] = args.time_limit
 

@@ -96,15 +96,15 @@ def _compiled_solver(
             )
 
         def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array, keys: jax.Array):
-            best_a, best_k = solve(m_rep, seed_rep, keys[0])
-            return best_a[None], best_k[None]
+            best_a, best_k, curve = solve(m_rep, seed_rep, keys[0])
+            return best_a[None], best_k[None], curve[None]
 
         fn = jax.jit(
             jax.shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(P(), P(), P(AXIS)),
-                out_specs=(P(AXIS), P(AXIS)),
+                out_specs=(P(AXIS), P(AXIS), P(AXIS)),
             )
         )
         _COMPILED[cache_key] = fn
@@ -124,9 +124,10 @@ def solve_on_mesh(
     engine: str = "chain",
 ):
     """Run the annealer sharded over `mesh`; returns the per-shard winners
-    ``(best_a [n_dev, P, R], best_k [n_dev])`` as device arrays — the
-    engine re-scores this final population (Pallas kernel on TPU) and
-    polishes the champion."""
+    ``(best_a [n_dev, P, R], best_k [n_dev], curve [n_dev, rounds])`` as
+    device arrays — the engine re-scores this final population (Pallas
+    kernel on TPU), polishes the champion, and logs the best-score
+    curve."""
     n_dev = mesh.devices.size
     fn = _compiled_solver(
         mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo, engine
@@ -135,7 +136,7 @@ def solve_on_mesh(
     return fn(m, a_seed, keys)
 
 
-def best_of(best_a, best_k):
+def best_of(best_a, best_k, curve=None):
     """Host-side argmax over the per-shard winners (the final cross-shard
     reduce — a few KB)."""
     best_a, best_k = jax.device_get((best_a, best_k))

@@ -374,7 +374,7 @@ def make_solver_fn(
     axis_name: str | None = None,
 ):
     """Full anneal as one jittable function: model + seed [P, R] + base key
-    -> (best_a [P, R], best_key scalar) for this shard. The model is a
+    -> (best_a [P, R], best_key scalar, curve [rounds]) for this shard. The model is a
     runtime argument, so jitting the returned function once covers every
     instance of the same shape (warm re-solves skip compilation)."""
     run_round = make_round_runner(steps_per_round, axis_name)
@@ -406,12 +406,12 @@ def make_solver_fn(
         def body(carry, temp):
             state, bk, ba = carry
             state, bk, ba = run_round(m, state, bk, ba, temp)
-            return (state, bk, ba), None
+            return (state, bk, ba), jnp.max(bk)  # best-score curve point
 
-        (state, best_k, best_a), _ = lax.scan(
+        (state, best_k, best_a), curve = lax.scan(
             body, (state, best_k, best_a), temps
         )
         top = jnp.argmax(best_k)
-        return best_a[top], best_k[top]
+        return best_a[top], best_k[top], curve
 
     return solve

@@ -14,6 +14,7 @@ North-star target (BASELINE.json): plan quality <= lp_solve's move count,
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.instance import ProblemInstance
+from ...utils import checkpoint as ckpt
 from ..base import SolveResult, register
 from . import arrays
 from .seed import greedy_seed
@@ -41,6 +43,10 @@ def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
     would leave the chain engine 1000x under-searched and vice versa)."""
     P = inst.num_parts
     on_tpu = platform == "tpu"
+    if engine is not None and engine not in ("chain", "sweep"):
+        raise ValueError(
+            f"unknown tpu engine {engine!r}; expected 'chain' or 'sweep'"
+        )
     engine = engine or (
         "sweep" if P >= _SWEEP_THRESHOLD_PARTS else "chain"
     )
@@ -73,6 +79,8 @@ def solve_tpu(
     t_lo: float | None = None,
     n_devices: int | None = None,
     engine: str | None = None,
+    checkpoint: str | None = None,
+    profile_dir: str | None = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
@@ -96,6 +104,25 @@ def solve_tpu(
     assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
         "seed left unfilled slots"
     )
+    resumed = False
+    if checkpoint:
+        # fail fast on an unwritable path BEFORE spending solve time
+        from pathlib import Path
+
+        Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
+        # resume (SURVEY.md §5): if a prior solve of this exact instance
+        # left a plan, seed from whichever of {checkpoint, greedy} ranks
+        # higher — the next solve can never regress below the last one
+        a_prev = ckpt.load(checkpoint, inst)
+        if a_prev is not None:
+            def rank(a):
+                pen = sum(inst.violations(a).values())
+                w = inst.preservation_weight(a)
+                return (pen == 0, -pen, w)
+
+            if rank(a_prev) >= rank(a_seed):
+                a_seed = a_prev
+                resumed = True
     m = arrays.from_instance(inst)
     t_seed = time.perf_counter()
 
@@ -108,18 +135,26 @@ def solve_tpu(
     n_dev = mesh.devices.size
     chains_per_device = max(1, batch // n_dev)
     key = jax.random.PRNGKey(seed)
-    pop_a, _pop_k = solve_on_mesh(
-        m,
-        jnp.asarray(a_seed, jnp.int32),
-        key,
-        mesh,
-        chains_per_device,
-        rounds,
-        steps_per_round,
-        t_hi=t_hi,
-        t_lo=t_lo,
-        engine=engine,
+
+    prof = (
+        jax.profiler.trace(profile_dir)  # SURVEY.md §5 tracing/profiling
+        if profile_dir
+        else contextlib.nullcontext()
     )
+    with prof:
+        pop_a, _pop_k, curve = solve_on_mesh(
+            m,
+            jnp.asarray(a_seed, jnp.int32),
+            key,
+            mesh,
+            chains_per_device,
+            rounds,
+            steps_per_round,
+            t_hi=t_hi,
+            t_lo=t_lo,
+            engine=engine,
+        )
+        jax.block_until_ready(pop_a)
     t_solve = time.perf_counter()
 
     # final selection: exact-rescore the per-shard winners on device (the
@@ -148,6 +183,19 @@ def solve_tpu(
     weight = inst.preservation_weight(best_a)
     feasible = all(v == 0 for v in viol.values())
 
+    if checkpoint:
+        ckpt.save(
+            checkpoint,
+            inst,
+            best_a,
+            meta={
+                "objective": int(weight),
+                "feasible": feasible,
+                "moves": int(inst.move_count(best_a)),
+                "engine": engine,
+            },
+        )
+
     return SolveResult(
         a=best_a,
         solver="tpu",
@@ -173,5 +221,18 @@ def solve_tpu(
             "moves": int(inst.move_count(best_a)),
             "feasible": feasible,
             "violations": sum(viol.values()),
+            "resumed_from_checkpoint": resumed,
+            # best-score trajectory (max over shards, downsampled): the
+            # convergence record SURVEY.md §5 calls for
+            "score_curve": _downsample(
+                np.asarray(jax.device_get(curve)).max(axis=0), 32
+            ),
         },
     )
+
+
+def _downsample(x: np.ndarray, n: int) -> list[int]:
+    if len(x) <= n:
+        return [int(v) for v in x]
+    idx = np.linspace(0, len(x) - 1, n).round().astype(int)
+    return [int(x[i]) for i in idx]

@@ -390,10 +390,10 @@ def make_sweep_solver_fn(
     snapshot_every: int = 8,
     axis_name: str | None = None,
 ):
-    """Build the jittable (m, a_seed [P, R], key) -> (best_a [P, R],
-    best_key scalar) sweep-parallel solver for one shard. Interface
-    matches ``anneal.make_solver_fn`` so ``parallel.mesh`` can host
-    either engine."""
+    """Build the jittable sweep-parallel solver for one shard:
+    (m, a_seed [P, R], key) -> (best_a [P, R], best_key scalar,
+    curve [sweeps]). Interface matches ``anneal.make_solver_fn`` so
+    ``parallel.mesh`` can host either engine."""
     temps = geometric_temps(t_hi, t_lo, sweeps)
 
     def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
@@ -437,7 +437,7 @@ def make_sweep_solver_fn(
                 do_snap, snap, lambda args: (args[1], args[2]),
                 (a, best_k, best_a),
             )
-            return (a, best_k, best_a, key), None
+            return (a, best_k, best_a, key), jnp.max(best_k)
 
         # snapshot every Nth sweep AND the final one: the coldest sweeps
         # improve the most and must never be discarded
@@ -448,10 +448,10 @@ def make_sweep_solver_fn(
         # odd sweeps run the count-invariant pair-exchange move; even
         # sweeps run single-site replace/lswap proposals
         do_exchange = jnp.arange(sweeps) % 2 == 1
-        (a, best_k, best_a, key), _ = lax.scan(
+        (a, best_k, best_a, key), curve = lax.scan(
             body, (a, best_k, best_a, key), (temps, do_snap, do_exchange)
         )
         top = jnp.argmax(best_k)
-        return best_a[top], best_k[top]
+        return best_a[top], best_k[top], curve
 
     return solve

@@ -1,0 +1,57 @@
+"""Checkpoint/resume + profiling hooks (SURVEY.md §5 aux subsystems)."""
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance, optimize
+from kafka_assignment_optimizer_tpu.utils import checkpoint as ckpt
+
+from tests.test_tpu_engine import random_cluster
+
+
+def test_checkpoint_roundtrip(demo, tmp_path):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    path = tmp_path / "plan.npz"
+    a = np.asarray(inst.a0).copy()
+    a[a >= inst.num_brokers] = 0
+    ckpt.save(path, inst, a, meta={"note": "test"})
+    back = ckpt.load(path, inst)
+    np.testing.assert_array_equal(back, a)
+
+
+def test_checkpoint_rejects_other_instance(demo, tmp_path, rng):
+    current, brokers, topo = demo
+    inst = build_instance(current, brokers, topo)
+    path = tmp_path / "plan.npz"
+    ckpt.save(path, inst, np.zeros((inst.num_parts, inst.max_rf), np.int32))
+    other_cur, other_brokers, other_topo = random_cluster(rng, 8, 10, 2, 2)
+    other = build_instance(other_cur, other_brokers, other_topo)
+    assert ckpt.load(path, other) is None
+    assert ckpt.load(tmp_path / "missing.npz", inst) is None
+
+
+def test_solve_saves_and_resumes(demo, tmp_path):
+    current, brokers, topo = demo
+    path = str(tmp_path / "demo.npz")
+    r1 = optimize(current, brokers, topo, solver="tpu",
+                  batch=8, rounds=4, steps_per_round=100, checkpoint=path)
+    assert (tmp_path / "demo.npz").exists()
+    assert not r1.solve.stats["resumed_from_checkpoint"]
+    # second solve resumes from the saved optimum and must stay there
+    r2 = optimize(current, brokers, topo, solver="tpu",
+                  batch=8, rounds=2, steps_per_round=50, checkpoint=path)
+    assert r2.solve.stats["resumed_from_checkpoint"]
+    assert r2.replica_moves == 1
+    assert r2.solve.objective >= r1.solve.objective
+
+
+def test_profile_trace_written(demo, tmp_path):
+    current, brokers, topo = demo
+    prof = tmp_path / "trace"
+    optimize(current, brokers, topo, solver="tpu",
+             batch=8, rounds=2, steps_per_round=50,
+             profile_dir=str(prof))
+    # jax.profiler.trace writes a plugins/ dir with one trace per run
+    produced = list(prof.rglob("*"))
+    assert produced, "profiler trace directory is empty"
