@@ -5,7 +5,8 @@ Runs the headline configuration (256 brokers / 8 racks / 10k partitions /
 RF=3, single-broker decommission) through the TPU annealing backend and
 prints ONE JSON line:
 
-    {"metric": ..., "value": <wall_clock_s>, "unit": "s", "vs_baseline": ...}
+    {"metric": ..., "value": <warm_wall_clock_s>, "unit": "s",
+     "vs_baseline": ..., "platform": ..., "cold_wall_clock_s": ...}
 
 ``vs_baseline`` is the speed-up vs the north-star budget of 5 s
 (BASELINE.json: "<= lp_solve's move count in <5s wall-clock"), gated on
@@ -13,52 +14,176 @@ plan quality: if the plan is infeasible, or moves exceed the provable
 minimum (the replicas hosted by the decommissioned broker), vs_baseline is
 reported as 0.0 — a fast wrong answer scores nothing.
 
+Robustness contract (round-1 postmortem): the site TPU plugin ("axon")
+can fail init with UNAVAILABLE *or hang for minutes*. This harness
+therefore never imports jax in the parent process. It probes backend
+init in a subprocess under a hard timeout, falls back to
+``JAX_PLATFORMS=''`` (automatic) and then ``cpu``, runs each scenario in
+a child process under a timeout, and ALWAYS prints the one-line JSON —
+on total failure the line carries ``"error"`` and ``vs_baseline: 0.0``.
+
 Flags: ``--scenario`` picks another BASELINE config, ``--smoke`` shrinks
 the instance for quick CPU checks, ``--all`` prints per-scenario results
-to stderr before the headline line.
+to stderr before the headline line, ``--kernel`` additionally times the
+Pallas scoring kernel vs the XLA scorer (TPU only).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
+BASELINE_BUDGET_S = 5.0  # north-star (BASELINE.json)
 
-def run_scenario(
-    name: str, smoke: bool = False, seed: int = 0, warm: bool = False
-) -> dict:
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:  # malformed override must not kill the harness
+        print(f"[bench] ignoring malformed {name}", file=sys.stderr)
+        return default
+
+
+PROBE_TIMEOUT_S = _env_float("KAO_PROBE_TIMEOUT", 240.0)
+CHILD_TIMEOUT_S = _env_float("KAO_BENCH_TIMEOUT", 1800.0)
+
+# config-level pinning, not just the env var: the site accelerator hook
+# wraps backend lookup and can override JAX_PLATFORMS unless the config is
+# set explicitly (same reason utils.platform.pin_platform exists)
+_PROBE_CODE = (
+    "import os, jax\n"
+    "w = os.environ.get('JAX_PLATFORMS')\n"
+    "if w: jax.config.update('jax_platforms', w)\n"
+    "print('PLATFORM=' + jax.devices()[0].platform)\n"
+)
+
+
+# --------------------------------------------------------------------------
+# parent side: backend probing + child orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _probe(env: dict, timeout: float) -> tuple[str | None, str | None]:
+    """Try backend init in a subprocess. Returns (platform, error)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            env=env, timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out after {timeout:.0f}s"
+    except OSError as e:  # pragma: no cover - exec failure
+        return None, f"probe exec failed: {e}"
+    if r.returncode == 0:
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip(), None
+        return None, "probe printed no platform"
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    return None, " | ".join(tail[-3:])[-500:] or f"probe rc={r.returncode}"
+
+
+def resolve_backend() -> tuple[dict, str, str | None]:
+    """Pick an environment whose jax backend provably initializes.
+
+    Attempt order: env as-is (site plugin may provide TPU), then
+    ``JAX_PLATFORMS=''`` (automatic choice, tolerates plugin failure),
+    then ``cpu`` (assumed always available). Returns
+    (env, platform, tpu_error) where tpu_error records why an
+    accelerator was NOT used, if so.
+    """
+    # attempt order, deduplicated: "env as-is" and "automatic" are the
+    # same probe when JAX_PLATFORMS is unset/empty — don't hang twice
+    attempts: list[str | None] = [None]
+    if os.environ.get("JAX_PLATFORMS"):
+        attempts.append("")
+    first_err: str | None = None
+    for override in attempts:
+        env = dict(os.environ)
+        if override is not None:
+            env["JAX_PLATFORMS"] = override
+        plat, err = _probe(env, PROBE_TIMEOUT_S)
+        if plat is not None:
+            return env, plat, first_err if plat == "cpu" else None
+        first_err = first_err or err
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # terminal fallback: assumed available
+    return env, "cpu", first_err
+
+
+def _run_child(
+    args: argparse.Namespace, name: str, env: dict, warmrun: bool
+) -> tuple[dict | None, str | None]:
+    """Run one scenario in a child process; returns (result, error)."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--scenario", name, "--seed", str(args.seed),
+    ]
+    if args.smoke:
+        cmd.append("--smoke")
+    if warmrun:
+        cmd.append("--warm")
+    if args.kernel and warmrun:
+        # the kernel micro-bench is headline-only: side-scenario children
+        # would burn minutes producing output that is never emitted
+        cmd.append("--kernel")
+    try:
+        r = subprocess.run(
+            cmd, env=env, timeout=CHILD_TIMEOUT_S, capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"scenario '{name}' timed out after {CHILD_TIMEOUT_S:.0f}s"
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("RESULT "):
+            try:
+                return json.loads(line[len("RESULT "):]), None
+            except json.JSONDecodeError as e:
+                return None, f"unparsable child result: {e}"
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    return None, " | ".join(tail[-4:])[-600:] or f"child rc={r.returncode}"
+
+
+# --------------------------------------------------------------------------
+# child side: actually solve (runs with a known-good JAX_PLATFORMS)
+# --------------------------------------------------------------------------
+
+def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
 
     pin_platform()
+    import jax
+
     from kafka_assignment_optimizer_tpu.api import optimize
     from kafka_assignment_optimizer_tpu.utils import gen
 
     if smoke:
-        shrunk = {
-            "demo": dict(),
-            "scale_out": dict(n_old=12, n_new=16, n_topics=8, parts_per_topic=10),
-            "decommission": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
-            "rf_change": dict(n_brokers=16, n_topics=4, parts_per_topic=25),
-            "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
-        }
-        sc = gen.SCENARIOS[name](**shrunk[name])
+        sc = gen.SCENARIOS[name](**gen.SMOKE_KWARGS[name])
     else:
         sc = gen.SCENARIOS[name]()
 
-    runs = 2 if warm else 1  # warm: time the second run (XLA caches the jit)
+    walls = []
+    runs = 2 if warm else 1  # warm: the second run reuses the jit cache
     for _ in range(runs):
         t0 = time.perf_counter()
         res = optimize(solver="tpu", seed=seed, **sc.kwargs)
-        wall = time.perf_counter() - t0
+        walls.append(time.perf_counter() - t0)
     report = res.report()
+    cold, warm_wall = walls[0], walls[-1]
     return {
         "scenario": sc.name,
         # end-to-end optimize() time: parse -> model -> solve -> decode -> diff
-        "wall_clock_s": round(wall, 3),
+        "wall_clock_s": round(warm_wall, 3),
+        "cold_wall_clock_s": round(cold, 3),
+        # compile + first-trace overhead: cold minus warm (only meaningful
+        # when both runs executed)
+        "compile_s": round(cold - warm_wall, 3) if warm else None,
         "solver_s": report["solver_wall_clock_s"],
         "warm": warm,
+        "platform": jax.devices()[0].platform,
         "moves": report["replica_moves"],
         "min_moves_lb": sc.min_moves_lb,
         "lb_tight": sc.lb_tight,
@@ -71,6 +196,75 @@ def run_scenario(
     }
 
 
+def run_kernel_bench(smoke: bool) -> dict:
+    """Time the Pallas scoring kernel (compiled, interpret=False) against
+    the pure-XLA scorer on a production-shaped batch. TPU-only: on CPU
+    the Mosaic path does not exist and this reports skipped."""
+    from kafka_assignment_optimizer_tpu.ops.bench_kernel import kernel_vs_xla
+
+    return kernel_vs_xla(smoke=smoke)
+
+
+def child_main(args: argparse.Namespace) -> int:
+    out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
+    if args.kernel:
+        try:
+            out["kernel"] = run_kernel_bench(args.smoke)
+        except Exception as e:  # noqa: BLE001 - kernel bench is best-effort
+            out["kernel"] = {"error": repr(e)[:300]}
+    print("RESULT " + json.dumps(out))
+    return 0
+
+
+# --------------------------------------------------------------------------
+
+
+def emit(head: dict | None, platform: str, tpu_error: str | None,
+         scenario: str, run_error: str | None = None) -> None:
+    """Print the one-line JSON. Never raises."""
+    if head is None:
+        line = {
+            "metric": f"{scenario}_wall_clock",
+            "value": 0.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "error": run_error or tpu_error or "unknown failure",
+        }
+        if tpu_error and run_error:
+            line["tpu_error"] = tpu_error
+        print(json.dumps(line))
+        return
+    error = tpu_error
+    # quality gate: feasible, and moves at the provable minimum when the
+    # bound is known achievable (a fast wrong answer scores nothing)
+    quality_ok = head["feasible"] and (
+        not head["lb_tight"] or head["moves"] <= head["min_moves_lb"]
+    )
+    wall = head["wall_clock_s"]
+    vs = round(BASELINE_BUDGET_S / wall, 3) if quality_ok and wall > 0 else 0.0
+    line = {
+        "metric": (
+            f"{head['scenario']}_{head['brokers']}b_{head['partitions']}p"
+            "_warm_wall_clock"
+        ),
+        "value": wall,
+        "unit": "s",
+        "vs_baseline": vs,
+        "platform": head.get("platform", platform),
+        "cold_wall_clock_s": head.get("cold_wall_clock_s"),
+        "compile_s": head.get("compile_s"),
+        "moves": head["moves"],
+        "min_moves_lb": head["min_moves_lb"],
+        "feasible": head["feasible"],
+    }
+    if error:
+        line["tpu_error"] = error  # why an accelerator was not used
+    if "kernel" in head:
+        line["kernel"] = head["kernel"]
+    print(json.dumps(line))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", default="decommission",
@@ -79,39 +273,56 @@ def main() -> int:
                     help="run every BASELINE scenario (extras to stderr)")
     ap.add_argument("--smoke", action="store_true", help="tiny instances")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kernel", action="store_true",
+                    help="also time Pallas kernel vs XLA scorer")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--warm", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    from kafka_assignment_optimizer_tpu.utils import gen
+    if args.child:
+        return child_main(args)
 
-    names = list(gen.SCENARIOS) if args.all else [args.scenario]
-    results = {}
+    try:
+        env, platform, tpu_err = resolve_backend()
+    except Exception as e:  # noqa: BLE001 - must never die before emitting
+        emit(None, "unknown", f"backend resolution failed: {e!r}",
+             args.scenario)
+        return 0
+    print(f"[bench] platform={platform}"
+          + (f" (accelerator unavailable: {tpu_err})" if tpu_err else ""),
+          file=sys.stderr)
+
+    if args.all:
+        # parent stays jax-free, so this duplicates gen.SCENARIOS' keys;
+        # keep in sync with kafka_assignment_optimizer_tpu/utils/gen.py
+        names = ["demo", "scale_out", "decommission", "rf_change",
+                 "leader_only"]
+    else:
+        names = [args.scenario]
+    head, head_err = None, None
     for name in names:
-        r = run_scenario(
-            name, smoke=args.smoke, seed=args.seed, warm=name == args.scenario
-        )
-        results[name] = r
+        is_head = name == args.scenario
+        r, err = _run_child(args, name, env, warmrun=is_head)
+        if r is None and platform != "cpu":
+            # accelerator succeeded at probe time but died mid-run:
+            # one CPU retry so the harness still lands a number. Only the
+            # headline's fallback is reported as tpu_error — a flaky
+            # side-scenario must not mislabel a successful headline run.
+            cpu_env = dict(env)
+            cpu_env["JAX_PLATFORMS"] = "cpu"
+            r2, err2 = _run_child(args, name, cpu_env, warmrun=is_head)
+            if r2 is not None:
+                if is_head:
+                    tpu_err = tpu_err or err
+                r, err = r2, err2
         if args.all:
-            print(json.dumps(r), file=sys.stderr)
+            print(json.dumps(r if r is not None else {"scenario": name,
+                                                      "error": err}),
+                  file=sys.stderr)
+        if is_head:
+            head, head_err = r, err
 
-    head = results[args.scenario]
-    baseline_s = 5.0  # north-star budget (BASELINE.json)
-    # quality gate: feasible, and moves at the provable minimum when the
-    # bound is known achievable (a fast wrong answer scores nothing)
-    quality_ok = head["feasible"] and (
-        not head["lb_tight"] or head["moves"] <= head["min_moves_lb"]
-    )
-    wall = head["wall_clock_s"]
-    vs = round(baseline_s / wall, 3) if quality_ok and wall > 0 else 0.0
-    line = {
-        "metric": f"{head['scenario']}_{head['brokers']}b_{head['partitions']}p_warm_wall_clock",
-        "value": wall,
-        "unit": "s",
-        "vs_baseline": vs,
-        "moves": head["moves"],
-        "min_moves_lb": head["min_moves_lb"],
-        "feasible": head["feasible"],
-    }
-    print(json.dumps(line))
+    emit(head, platform, tpu_err, args.scenario, head_err)
     return 0
 
 

@@ -84,7 +84,9 @@ def solve_tpu(
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
-    platform = jax.devices()[0].platform
+    from ...utils.platform import ensure_backend
+
+    platform = ensure_backend()
     d = _defaults(inst, platform, engine)
     engine = d["engine"]
     batch = batch or d["batch"]

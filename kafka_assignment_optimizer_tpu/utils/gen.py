@@ -249,3 +249,14 @@ SCENARIOS = {
     "rf_change": rf_change,
     "leader_only": leader_only,
 }
+
+# shrunk per-scenario kwargs for quick CPU smoke runs: the single source of
+# truth shared by bench.py (--smoke) and ops.bench_kernel, so the scenario
+# solve and the embedded kernel micro-bench always measure the same instance
+SMOKE_KWARGS = {
+    "demo": dict(),
+    "scale_out": dict(n_old=12, n_new=16, n_topics=8, parts_per_topic=10),
+    "decommission": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+    "rf_change": dict(n_brokers=16, n_topics=4, parts_per_topic=25),
+    "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+}

@@ -22,3 +22,33 @@ def pin_platform(platform: str | None = None) -> None:
 
     if jax.config.jax_platforms != want:
         jax.config.update("jax_platforms", want)
+
+
+def ensure_backend() -> str:
+    """Initialize a JAX backend, surviving a broken accelerator plugin.
+
+    Round-1 postmortem: the site TPU plugin can fail init with
+    ``RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE``,
+    which killed every solve before a single op ran. Attempt order:
+    current config, then ``jax_platforms=''`` (automatic choice, which
+    tolerates plugin failure), then ``cpu``. Returns the platform of the
+    default device. Must be called before any device arrays exist —
+    recovery resets the backend registry (``clear_backends``).
+
+    (A *hanging* plugin cannot be recovered in-process; ``bench.py``
+    handles that case with subprocess probes under a timeout.)
+    """
+    import jax
+
+    last: Exception | None = None
+    for override in (None, "", "cpu"):
+        try:
+            if override is not None:
+                from jax.extend.backend import clear_backends
+
+                jax.config.update("jax_platforms", override)
+                clear_backends()
+            return jax.devices()[0].platform
+        except RuntimeError as e:  # backend init failure
+            last = e
+    raise RuntimeError(f"no usable JAX backend: {last}")  # pragma: no cover
