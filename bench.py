@@ -293,10 +293,12 @@ def main() -> int:
           file=sys.stderr)
 
     if args.all:
-        # parent stays jax-free, so this duplicates gen.SCENARIOS' keys;
-        # keep in sync with kafka_assignment_optimizer_tpu/utils/gen.py
-        names = ["demo", "scale_out", "decommission", "rf_change",
-                 "leader_only"]
+        # importing the package is safe in the parent — the robustness
+        # invariant is that the parent never *initializes* a jax backend
+        # (jax.devices() is what hangs/fails, not `import jax`)
+        from kafka_assignment_optimizer_tpu.utils import gen
+
+        names = list(gen.SCENARIOS)
     else:
         names = [args.scenario]
     head, head_err = None, None

@@ -38,11 +38,12 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
-# compiled sharded solvers, keyed by (device ids, search params); the model
-# is a runtime argument, so jax.jit's own shape keying handles different
-# instance sizes and *warm re-solves of same-shape instances skip
-# compilation entirely*. Bounded: a long-lived service solving a stream of
-# differently sized instances must not accumulate executables forever.
+# compiled sharded solvers, keyed by (device ids, search params); the
+# model and the temperature ladder are runtime arguments, so jax.jit's own
+# shape keying handles different instance sizes / schedule lengths and
+# *warm re-solves of same-shape instances skip compilation entirely*.
+# Bounded: a long-lived service solving a stream of differently sized
+# instances must not accumulate executables forever.
 _COMPILED: dict[tuple, object] = {}
 _COMPILED_MAX = 16
 
@@ -50,16 +51,12 @@ _COMPILED_MAX = 16
 def _compiled_solver(
     mesh: Mesh,
     chains_per_device: int,
-    rounds: int,
     steps_per_round: int,
-    t_hi: float,
-    t_lo: float,
     engine: str = "chain",
 ):
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
-        chains_per_device, rounds, steps_per_round, float(t_hi), float(t_lo),
-        engine,
+        chains_per_device, steps_per_round, engine,
     )
     fn = _COMPILED.get(cache_key)
     if fn is not None:  # LRU refresh: insertion order tracks recency
@@ -73,37 +70,27 @@ def _compiled_solver(
         if engine == "sweep":
             from ..solvers.tpu.sweep import make_sweep_solver_fn
 
-            # rounds * steps_per_round is the step budget per chain in the
-            # chain engine; the sweep engine's sequential budget is just
-            # `rounds` sweeps (each sweep touches every partition)
-            solve = make_sweep_solver_fn(
-                chains_per_device,
-                sweeps=rounds,
-                t_hi=t_hi,
-                t_lo=t_lo,
-                axis_name=AXIS,
-            )
+            # the chain engine's per-chain budget is rounds*steps_per_round
+            # steps; the sweep engine's sequential budget is len(temps)
+            # sweeps (each sweep touches every partition)
+            solve = make_sweep_solver_fn(chains_per_device, axis_name=AXIS)
         else:
             from ..solvers.tpu.anneal import make_solver_fn
 
             solve = make_solver_fn(
-                chains_per_device,
-                rounds,
-                steps_per_round,
-                t_hi=t_hi,
-                t_lo=t_lo,
-                axis_name=AXIS,
+                chains_per_device, steps_per_round, axis_name=AXIS
             )
 
-        def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array, keys: jax.Array):
-            best_a, best_k, curve = solve(m_rep, seed_rep, keys[0])
+        def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array,
+                     keys: jax.Array, temps: jax.Array):
+            best_a, best_k, curve = solve(m_rep, seed_rep, keys[0], temps)
             return best_a[None], best_k[None], curve[None]
 
         fn = jax.jit(
             jax.shard_map(
                 shard_fn,
                 mesh=mesh,
-                in_specs=(P(), P(), P(AXIS)),
+                in_specs=(P(), P(), P(AXIS), P()),
                 out_specs=(P(AXIS), P(AXIS), P(AXIS)),
             )
         )
@@ -122,18 +109,23 @@ def solve_on_mesh(
     t_hi: float = 2.5,
     t_lo: float = 0.05,
     engine: str = "chain",
+    temps: jax.Array | None = None,
 ):
     """Run the annealer sharded over `mesh`; returns the per-shard winners
     ``(best_a [n_dev, P, R], best_k [n_dev], curve [n_dev, rounds])`` as
     device arrays — the engine re-scores this final population (Pallas
     kernel on TPU), polishes the champion, and logs the best-score
-    curve."""
+    curve. ``temps`` (a schedule segment) overrides the default
+    ``geometric_temps(t_hi, t_lo, rounds)`` ladder — the engine passes
+    per-chunk segments when honoring ``time_limit_s``."""
+    from ..solvers.tpu.arrays import geometric_temps
+
     n_dev = mesh.devices.size
-    fn = _compiled_solver(
-        mesh, chains_per_device, rounds, steps_per_round, t_hi, t_lo, engine
-    )
+    fn = _compiled_solver(mesh, chains_per_device, steps_per_round, engine)
+    if temps is None:
+        temps = geometric_temps(t_hi, t_lo, rounds)
     keys = jax.random.split(key, n_dev)
-    return fn(m, a_seed, keys)
+    return fn(m, a_seed, keys, temps)
 
 
 def best_of(best_a, best_k, curve=None):

@@ -35,7 +35,6 @@ from .arrays import (
     SCALE_W,
     ModelArrays,
     band_pen as _shared_band_pen,
-    geometric_temps,
     u01 as _shared_u01,
 )
 
@@ -367,20 +366,20 @@ def make_round_runner(steps_per_round: int, axis_name: str | None):
 
 def make_solver_fn(
     n_chains: int,
-    rounds: int,
     steps_per_round: int,
-    t_hi: float = 2.5,
-    t_lo: float = 0.05,
     axis_name: str | None = None,
 ):
     """Full anneal as one jittable function: model + seed [P, R] + base key
-    -> (best_a [P, R], best_key scalar, curve [rounds]) for this shard. The model is a
-    runtime argument, so jitting the returned function once covers every
-    instance of the same shape (warm re-solves skip compilation)."""
+    + temps [rounds] -> (best_a [P, R], best_key scalar, curve [rounds])
+    for this shard. The model AND the temperature ladder are runtime
+    arguments, so one compiled executable covers every same-shape instance
+    and every schedule segment — which is what lets the engine run the
+    anneal in clock-checked chunks (``time_limit_s``) without recompiling
+    per chunk."""
     run_round = make_round_runner(steps_per_round, axis_name)
-    temps = geometric_temps(t_hi, t_lo, rounds)
 
-    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
+    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
+              temps: jax.Array):
         keys = random.split(key, n_chains)
         state = jax.vmap(lambda k: init_chain(m, a_seed, k))(keys)
         # snapshot the SEED itself before any annealing: high-temperature

@@ -37,7 +37,6 @@ from .arrays import (
     SCALE_W,
     ModelArrays,
     band_pen as _band_pen,
-    geometric_temps,
     u01 as _u01,
 )
 
@@ -384,19 +383,19 @@ def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
 
 def make_sweep_solver_fn(
     n_chains: int,
-    sweeps: int,
-    t_hi: float = 2.0,
-    t_lo: float = 0.02,
     snapshot_every: int = 8,
     axis_name: str | None = None,
 ):
     """Build the jittable sweep-parallel solver for one shard:
-    (m, a_seed [P, R], key) -> (best_a [P, R], best_key scalar,
-    curve [sweeps]). Interface matches ``anneal.make_solver_fn`` so
-    ``parallel.mesh`` can host either engine."""
-    temps = geometric_temps(t_hi, t_lo, sweeps)
+    (m, a_seed [P, R], key, temps [sweeps]) -> (best_a [P, R], best_key
+    scalar, curve [sweeps]). Interface matches ``anneal.make_solver_fn``
+    so ``parallel.mesh`` can host either engine; the temperature ladder
+    is a runtime argument so clock-checked chunked solves reuse one
+    executable."""
 
-    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array):
+    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
+              temps: jax.Array):
+        sweeps = temps.shape[0]
         P, R = a_seed.shape
         a = jnp.broadcast_to(a_seed.astype(jnp.int32), (n_chains, P, R))
         w0, p0 = chain_scores(m, a)
@@ -428,14 +427,44 @@ def make_sweep_solver_fn(
                 w, pen = chain_scores(m, a)
                 k = best_key(w, pen)
                 improved = k > best_k
-                return (
-                    jnp.where(improved, k, best_k),
-                    jnp.where(improved[:, None, None], a, best_a),
-                )
+                best_k = jnp.where(improved, k, best_k)
+                best_a = jnp.where(improved[:, None, None], a, best_a)
+                if axis_name is not None:
+                    # ICI best-migration at the snapshot boundary
+                    # (VERDICT r1 item 5): locate the globally best
+                    # *current* chain (pmax; lowest shard index breaks
+                    # ties), broadcast it with a masked psum, and clone
+                    # it over this shard's worst chain — the same
+                    # owner-broadcast the chain engine runs every round
+                    # (anneal.make_round_runner), amortized here to once
+                    # per snapshot because a sweep moves every partition.
+                    local_best = jnp.max(k)
+                    global_best = lax.pmax(local_best, axis_name)
+                    idx = lax.axis_index(axis_name)
+                    am_owner = local_best == global_best
+                    owner = lax.pmin(
+                        jnp.where(am_owner, idx, jnp.iinfo(jnp.int32).max),
+                        axis_name,
+                    )
+                    src = jnp.argmax(k)
+                    cand = jnp.where(idx == owner, a[src],
+                                     jnp.zeros_like(a[src]))
+                    g = lax.psum(cand, axis_name)
+                    dst = jnp.argmin(k)
+                    a = a.at[dst].set(g)
+                    # harvest the migrant NOW (its key is global_best by
+                    # construction) — waiting for the next snapshot would
+                    # make the final sweep's migration dead and leave
+                    # short schedules with no propagation at all
+                    take = global_best > best_k[dst]
+                    best_k = best_k.at[dst].max(global_best)
+                    best_a = best_a.at[dst].set(
+                        jnp.where(take, g, best_a[dst])
+                    )
+                return a, best_k, best_a
 
-            best_k, best_a = lax.cond(
-                do_snap, snap, lambda args: (args[1], args[2]),
-                (a, best_k, best_a),
+            a, best_k, best_a = lax.cond(
+                do_snap, snap, lambda args: args, (a, best_k, best_a)
             )
             return (a, best_k, best_a, key), jnp.max(best_k)
 
