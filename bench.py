@@ -184,6 +184,9 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         "solver_s": report["solver_wall_clock_s"],
         "warm": warm,
         "platform": jax.devices()[0].platform,
+        "engine": report.get("solver_engine"),
+        "scorer": report.get("solver_scorer"),
+        "pallas_fallback": report.get("solver_pallas_fallback"),
         "moves": report["replica_moves"],
         "min_moves_lb": sc.min_moves_lb,
         "lb_tight": sc.lb_tight,
@@ -257,7 +260,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         "moves": head["moves"],
         "min_moves_lb": head["min_moves_lb"],
         "feasible": head["feasible"],
+        "engine": head.get("engine"),
+        "scorer": head.get("scorer"),
     }
+    if head.get("pallas_fallback"):
+        line["pallas_fallback"] = head["pallas_fallback"]
     if error:
         line["tpu_error"] = error  # why an accelerator was not used
     if "kernel" in head:

@@ -33,16 +33,18 @@ def lib_path() -> Path:
     return _build_dir() / f"libkao_{digest}.so"
 
 
-def build(verbose: bool = False) -> Path:
-    out = lib_path()
+def _compile(src: Path, out: Path, extra_flags: list[str],
+             verbose: bool = False) -> Path:
+    """Compile ``src`` to ``out`` with g++ if not already present:
+    content-addressed artifact names make staleness impossible, a
+    tempdir + ``os.replace`` makes concurrent builds publish atomically."""
     if out.exists():
         return out
     with tempfile.TemporaryDirectory(dir=_build_dir()) as td:
         tmp = Path(td) / out.name
         cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-            "-Wall", "-Wextra",
-            str(_SRC), "-o", str(tmp),
+            "g++", "-std=c++17", "-Wall", "-Wextra", *extra_flags,
+            str(src), "-o", str(tmp),
         ]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
@@ -53,6 +55,10 @@ def build(verbose: bool = False) -> Path:
             print(proc.stderr)
         os.replace(tmp, out)  # atomic publish
     return out
+
+
+def build(verbose: bool = False) -> Path:
+    return _compile(_SRC, lib_path(), ["-O3", "-shared", "-fPIC"], verbose)
 
 
 _LIB: ctypes.CDLL | None = None
@@ -76,3 +82,20 @@ def load() -> ctypes.CDLL:
         ]
         _LIB = lib
     return _LIB
+
+
+# ---------------------------------------------------------------------------
+# bundled lp_solve work-alike CLI (lp_cli.cpp)
+
+_LP_SRC = Path(__file__).with_name("lp_cli.cpp")
+
+
+def lp_cli_path() -> Path:
+    digest = hashlib.sha256(_LP_SRC.read_bytes()).hexdigest()[:16]
+    return _build_dir() / f"lp_cli_{digest}"
+
+
+def build_lp_cli() -> Path:
+    """Compile the bundled lp_solve-compatible CLI (LP-format parser +
+    exact 0-1 branch-and-bound, ``lp_cli.cpp``) on first use."""
+    return _compile(_LP_SRC, lp_cli_path(), ["-O2"])

@@ -53,10 +53,11 @@ def _compiled_solver(
     chains_per_device: int,
     steps_per_round: int,
     engine: str = "chain",
+    scorer: str = "xla",
 ):
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
-        chains_per_device, steps_per_round, engine,
+        chains_per_device, steps_per_round, engine, scorer,
     )
     fn = _COMPILED.get(cache_key)
     if fn is not None:  # LRU refresh: insertion order tracks recency
@@ -73,7 +74,9 @@ def _compiled_solver(
             # the chain engine's per-chain budget is rounds*steps_per_round
             # steps; the sweep engine's sequential budget is len(temps)
             # sweeps (each sweep touches every partition)
-            solve = make_sweep_solver_fn(chains_per_device, axis_name=AXIS)
+            solve = make_sweep_solver_fn(
+                chains_per_device, axis_name=AXIS, scorer=scorer
+            )
         else:
             from ..solvers.tpu.anneal import make_solver_fn
 
@@ -110,6 +113,7 @@ def solve_on_mesh(
     t_lo: float = 0.05,
     engine: str = "chain",
     temps: jax.Array | None = None,
+    scorer: str = "xla",
 ):
     """Run the annealer sharded over `mesh`; returns the per-shard winners
     ``(best_a [n_dev, P, R], best_k [n_dev], curve [n_dev, rounds])`` as
@@ -117,11 +121,14 @@ def solve_on_mesh(
     kernel on TPU), polishes the champion, and logs the best-score
     curve. ``temps`` (a schedule segment) overrides the default
     ``geometric_temps(t_hi, t_lo, rounds)`` ladder — the engine passes
-    per-chunk segments when honoring ``time_limit_s``."""
+    per-chunk segments when honoring ``time_limit_s``. ``scorer`` picks
+    the sweep engine's bulk-rescoring path (Pallas kernel on TPU)."""
     from ..solvers.tpu.arrays import geometric_temps
 
     n_dev = mesh.devices.size
-    fn = _compiled_solver(mesh, chains_per_device, steps_per_round, engine)
+    fn = _compiled_solver(
+        mesh, chains_per_device, steps_per_round, engine, scorer
+    )
     if temps is None:
         temps = geometric_temps(t_hi, t_lo, rounds)
     keys = jax.random.split(key, n_dev)

@@ -171,36 +171,86 @@ def parse_lp_solve_output(
     return a
 
 
+def _bundled_lp_solve() -> Path | None:
+    """Build (once) and return the bundled lp_solve-compatible CLI.
+
+    Upstream lp_solve 5.5 cannot be fetched here (no network egress), so
+    the repo bundles a work-alike (``native/lp_cli.cpp``): a real
+    separate binary that parses the emitted LP text and solves the 0-1
+    program exactly — the subprocess path executes end to end either
+    way. A system ``lp_solve`` on PATH always takes precedence."""
+    try:
+        from ..native import build_lp_cli
+
+        return build_lp_cli()
+    except Exception:  # no g++ / build failure: path simply unavailable
+        return None
+
+
+def _lp_solve_exe() -> tuple[str, bool] | None:
+    """(executable, is_system) for the preferred LP-solving subprocess."""
+    exe = shutil.which("lp_solve")
+    if exe is not None:
+        return exe, True
+    bundled = _bundled_lp_solve()
+    if bundled is not None:
+        return str(bundled), False
+    return None
+
+
 def lp_solve_available() -> bool:
-    return shutil.which("lp_solve") is not None
+    return _lp_solve_exe() is not None
 
 
 @register("lp_solve")
 def solve_lp_solve(
     inst: ProblemInstance, time_limit_s: float = 600.0, **_unused
 ) -> SolveResult:
-    if not lp_solve_available():
+    picked = _lp_solve_exe()
+    if picked is None:
         raise RuntimeError(
-            "lp_solve binary not on PATH; use --solver=milp for the exact "
-            "in-process backend"
+            "no lp_solve binary on PATH and the bundled lp_cli failed to "
+            "build; use --solver=milp for the exact in-process backend"
         )
+    exe, is_system = picked
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory() as td:
         lp_path = Path(td) / "model.lp"
         lp_path.write_text(emit_lp(inst))
-        proc = subprocess.run(
-            ["lp_solve", "-S4", str(lp_path)],
-            capture_output=True,
-            text=True,
-            timeout=time_limit_s,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(f"lp_solve failed: {proc.stderr[:500]}")
+        # both the system lp_solve 5.5 and the bundled CLI honor
+        # -timeout and return their best-so-far incumbent as rc=1; the
+        # subprocess timeout is only a backstop against a hung binary
+        cmd = [exe, "-S4", "-timeout", str(int(max(1, time_limit_s))),
+               str(lp_path)]
+        try:
+            proc = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                timeout=time_limit_s + 30.0,
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeError(
+                f"lp_solve ignored -timeout and ran past "
+                f"{time_limit_s + 30.0:.0f}s; raise --time-limit or use "
+                "--solver=milp"
+            ) from e
+        if proc.returncode == 7:  # timeout before any incumbent
+            raise RuntimeError(
+                f"lp_solve found no solution within {time_limit_s:.0f}s; "
+                "raise --time-limit or use --solver=milp"
+            )
+        if proc.returncode not in (0, 1):  # 1 = feasible but timed out
+            raise RuntimeError(
+                f"lp_solve failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[:500]}"
+            )
         a = parse_lp_solve_output(inst, proc.stdout)
     return SolveResult(
         a=a,
         solver="lp_solve",
         wall_clock_s=time.perf_counter() - t0,
         objective=inst.preservation_weight(a),
-        optimal=True,
+        optimal=proc.returncode == 0,
+        stats={"backend": "system" if is_system else "bundled_lp_cli"},
     )

@@ -177,3 +177,36 @@ def test_sweep_migration_propagates_global_best(rng):
     assert bk.max() >= good_key
     # every shard — including all poisoned ones — got the global best
     assert (bk >= good_key).all(), bk
+
+
+def test_sweep_solver_pallas_scorer_bit_identical(rng):
+    """The TPU hot path routes per-sweep rescoring through the Pallas
+    kernel (VERDICT r1 item 3). The kernel and the XLA scatter scorer
+    return identical integers, so the whole sweep trajectory — accepts,
+    thinning, snapshots — must be bit-identical between scorers. CI runs
+    the kernel in interpret mode; on TPU the same code path compiles via
+    Mosaic."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.arrays import (
+        geometric_temps,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        make_sweep_solver_fn,
+    )
+
+    current, brokers, topo = random_cluster(rng, 10, 16, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    key = jax.random.PRNGKey(7)
+    temps = geometric_temps(2.0, 0.02, 10)
+    outs = {}
+    for scorer in ("xla", "pallas-interpret"):
+        solve = make_sweep_solver_fn(n_chains=3, snapshot_every=4,
+                                     scorer=scorer)
+        ba, bk, curve = jax.jit(solve)(m, seed, key, temps)
+        outs[scorer] = (np.asarray(ba), int(bk), np.asarray(curve))
+    a_x, k_x, c_x = outs["xla"]
+    a_p, k_p, c_p = outs["pallas-interpret"]
+    assert k_x == k_p
+    np.testing.assert_array_equal(a_x, a_p)
+    np.testing.assert_array_equal(c_x, c_p)
