@@ -24,8 +24,13 @@ Endpoints:
         {"assignment": {...reassignment JSON...},              # the plan
          "report": {...observability report (SURVEY.md §5)...}}
 
-    Errors: 400 malformed JSON/schema (body ``{"error": ...}``),
-    422 model rejected the inputs, 500 solver failure.
+    ``options`` accepts search knobs only (``ALLOWED_OPTIONS``);
+    path-valued solver kwargs are rejected. Every solve is capped at the
+    server's ``--max-solve-s`` budget.
+
+    Errors: 400 malformed JSON/schema or disallowed option (body
+    ``{"error": ...}``), 422 model rejected the inputs, 500 solver
+    failure, 503 solver saturated past ``--lock-wait-s``.
 
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
@@ -50,6 +55,23 @@ from .models.cluster import Assignment, Topology, parse_broker_list
 _SOLVE_LOCK = threading.Lock()
 
 MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
+
+# Options the HTTP surface forwards to solvers: search-effort knobs only.
+# Path-valued solver kwargs (``checkpoint``, ``profile_dir``) are
+# deliberately NOT forwardable — a remote client must never be able to
+# make the service create directories or read/write files at
+# client-chosen paths. Operators who want checkpointing use the CLI.
+ALLOWED_OPTIONS = frozenset({
+    "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
+    "time_limit_s", "t_hi", "t_lo", "n_devices",
+})
+
+# saturation policy: how long a request waits for the solve lock before
+# the service sheds it with 503 (a single 10k-partition solve must not
+# make every later POST hang indefinitely), and the time limit injected
+# into each solve unless the client sets a smaller one
+DEFAULT_LOCK_WAIT_S = 30.0
+DEFAULT_MAX_SOLVE_S = 300.0
 
 
 class ApiError(Exception):
@@ -79,9 +101,15 @@ def _parse_topology(spec, broker_ids: list[int]) -> Topology | None:
     raise ApiError(400, "'topology' must be a broker->rack object, 'even-odd', or null")
 
 
-def handle_submit(payload: dict) -> dict:
+def handle_submit(
+    payload: dict,
+    *,
+    lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+    max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+) -> dict:
     """Pure request handler (also the unit-test surface): payload dict in,
-    response dict out; raises ApiError with an HTTP status on bad input."""
+    response dict out; raises ApiError with an HTTP status on bad input,
+    and 503 when the solver is saturated past ``lock_wait_s``."""
     if not isinstance(payload, dict):
         raise ApiError(400, "request body must be a JSON object")
     if "assignment" not in payload:
@@ -112,13 +140,36 @@ def handle_submit(payload: dict) -> dict:
     options = payload.get("options") or {}
     if not isinstance(options, dict):
         raise ApiError(400, "'options' must be an object")
+    rejected = sorted(set(options) - ALLOWED_OPTIONS)
+    if rejected:
+        raise ApiError(
+            400,
+            f"unsupported option(s) {rejected}; allowed: "
+            f"{sorted(ALLOWED_OPTIONS)}",
+        )
+    options = dict(options)  # never mutate the caller's payload
+    limit = options.get("time_limit_s")
+    if limit is not None and (
+        isinstance(limit, bool) or not isinstance(limit, (int, float))
+        or not limit > 0
+    ):
+        raise ApiError(400, "'time_limit_s' must be a positive number")
+    if max_solve_s is not None:
+        # cap every solve: client may tighten the limit but not exceed it
+        options["time_limit_s"] = (
+            max_solve_s if limit is None else min(float(limit), max_solve_s)
+        )
 
+    if not _SOLVE_LOCK.acquire(timeout=lock_wait_s):
+        raise ApiError(
+            503,
+            f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
+        )
     try:
-        with _SOLVE_LOCK:
-            res = optimize(
-                current, brokers, topology, target_rf=rf, solver=solver,
-                **options,
-            )
+        res = optimize(
+            current, brokers, topology, target_rf=rf, solver=solver,
+            **options,
+        )
     except (ValueError, KeyError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
         raise ApiError(422, f"model rejected inputs: {msg}") from e
@@ -126,6 +177,8 @@ def handle_submit(payload: dict) -> dict:
         raise ApiError(400, f"bad solver options: {e}") from e
     except RuntimeError as e:
         raise ApiError(500, f"solver failed: {e}") from e
+    finally:
+        _SOLVE_LOCK.release()
     return {
         "assignment": res.assignment.to_dict(),
         "report": res.report(),
@@ -187,7 +240,13 @@ class Handler(BaseHTTPRequestHandler):
                 payload = json.loads(raw)
             except json.JSONDecodeError as e:
                 raise ApiError(400, f"invalid JSON: {e}") from e
-            self._send(200, handle_submit(payload))
+            self._send(200, handle_submit(
+                payload,
+                lock_wait_s=getattr(self.server, "lock_wait_s",
+                                    DEFAULT_LOCK_WAIT_S),
+                max_solve_s=getattr(self.server, "max_solve_s",
+                                    DEFAULT_MAX_SOLVE_S),
+            ))
         except ApiError as e:
             self._send(e.status, {"error": str(e)})
         except Exception as e:  # never leak a traceback as a hung socket
@@ -195,9 +254,14 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def make_server(host: str = "127.0.0.1", port: int = 8787,
-                verbose: bool = False) -> ThreadingHTTPServer:
+                verbose: bool = False,
+                lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+                max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+                ) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer((host, port), Handler)
     srv.verbose = verbose
+    srv.lock_wait_s = lock_wait_s
+    srv.max_solve_s = max_solve_s
     return srv
 
 
@@ -209,11 +273,23 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8787)
     ap.add_argument("--verbose", action="store_true", help="access logs")
+    ap.add_argument("--lock-wait-s", type=float,
+                    default=DEFAULT_LOCK_WAIT_S,
+                    help="max seconds a request waits for the solver "
+                         "before 503 (saturation shedding)")
+    ap.add_argument("--max-solve-s", type=float,
+                    default=DEFAULT_MAX_SOLVE_S,
+                    help="time limit injected into every solve; clients "
+                         "may tighten but not exceed it (0 = uncapped)")
     args = ap.parse_args(argv)
     from .utils.platform import pin_platform
 
     pin_platform()
-    srv = make_server(args.host, args.port, verbose=args.verbose)
+    srv = make_server(
+        args.host, args.port, verbose=args.verbose,
+        lock_wait_s=args.lock_wait_s,
+        max_solve_s=args.max_solve_s or None,
+    )
     print(f"listening on http://{args.host}:{srv.server_address[1]}", file=sys.stderr)
     try:
         srv.serve_forever()

@@ -102,9 +102,14 @@ def emit_lp(inst: ProblemInstance) -> str:
         [b for b in range(B) if int(inst.rack_of_broker[b]) == k]
         for k in range(K)
     ]
-    out.append("// Constrain on min/max total replicas per racks")
+    # each rack block carries its rack name in the comment, matching the
+    # reference sample's "... per racks. tor02 here" (README.md:173)
     for k in range(K):
         members = rack_members[k]
+        out.append(
+            "// Constrain on min/max total replicas per racks. "
+            f"{inst.rack_names[k]} here"
+        )
         vs = [
             var_name(inst, p, b, r)
             for b in members
@@ -115,10 +120,15 @@ def emit_lp(inst: ProblemInstance) -> str:
         out.append(row(vs, ">=", int(inst.rack_lo[k])))
     out.append("")
 
-    # C10 per-partition per-rack diversity (README.md:178-180)
-    out.append("// Constrain on min/max replicas per partitions per racks")
+    # C10 per-partition per-rack diversity (README.md:178-180); comment
+    # names the (partition, rack) pair per the sample's "p0 on tor02
+    # here" (README.md:178)
     for p in range(P):
         for k in range(K):
+            out.append(
+                "// Constrain on min/max replicas per partitions per "
+                f"racks. p{p} on {inst.rack_names[k]} here"
+            )
             vs = [
                 var_name(inst, p, b, r)
                 for b in rack_members[k]

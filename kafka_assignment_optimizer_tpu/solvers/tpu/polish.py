@@ -8,8 +8,9 @@ in-partition leader swaps — as one dense ``[P, R, B]`` tensor computation
 (gathers over the count histograms, no scatter), applies the single best
 improving move, and repeats under ``lax.while_loop`` until no move
 improves. The result is certifiably 1-move locally optimal under the
-exact integer objective: the neighborhood an lp_solve-style exact solve
-can only beat with multi-move interactions.
+exact integer objective with a fewest-moves tie-break (equal-score moves
+that restore an original broker are taken): the neighborhood an
+lp_solve-style exact solve can only beat with multi-move interactions.
 
 One sweep is O(P·R·B) VPU work (~8M lanes at 256 brokers / 10k
 partitions) — microseconds on a TPU core, so even hundreds of polish
@@ -140,6 +141,22 @@ def polish(m: ModelArrays, a: jax.Array, max_moves: int = 4096) -> jax.Array:
         flat, cnt, lcnt, rcnt, pr = _counts(m, a)
         d_rep = _replace_deltas(m, flat, cnt, lcnt, rcnt, pr)  # [P, R, B]
         d_lsw = _lswap_deltas(m, flat, lcnt)  # [P, R]
+
+        # fewest-moves tie-break: the weight tiers alias move counts
+        # (4 = 2+2), so zero-delta moves that swap a non-member broker
+        # for an original member exist; scale the exact delta by 4 and
+        # add the move-count gain in the low bits so such moves count as
+        # improving. Per-move deltas are tiny ints — no overflow. The
+        # _NEG mask must not be scaled (it would wrap int32).
+        member = (m.w_lead[:, :B] > 0)  # [P, B] original-membership
+        gain_in = member.astype(jnp.int32)[:, None, :]  # replacing in
+        gain_out = jnp.take_along_axis(
+            m.w_lead, flat, axis=1
+        ).astype(jnp.bool_).astype(jnp.int32)[:, :, None]  # replacing out
+        d_rep = jnp.where(
+            d_rep == _NEG, _NEG, d_rep * 4 + (gain_in - gain_out)
+        )
+        d_lsw = jnp.where(d_lsw == _NEG, _NEG, d_lsw * 4)
 
         best_rep = jnp.max(d_rep)
         best_lsw = jnp.max(d_lsw)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax, random
 
+from ...ops.score import moves_batch
 from .arrays import (
     LAMBDA,
     SCALE_W,
@@ -315,27 +316,30 @@ def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
         return a
 
     kperm, kbits = random.split(key)
-    perm = random.permutation(kperm, P)
-    u = perm[:H]  # [H] first of each pair
-    v = perm[H : 2 * H]
+    # independent pairing per chain (ADVICE r1): one permutation shared
+    # by all N chains would give every chain identical pair structure
+    # each sweep, collapsing cross-chain diversity of this move type
+    perms = jax.vmap(random.permutation, in_axes=(0, None))(
+        random.split(kperm, N), P
+    )  # [N, P]
+    u2 = perms[:, :H]  # [N, H] first of each pair
+    v2 = perms[:, H : 2 * H]
     bits = random.bits(kbits, (N, H, 4), jnp.uint32)
 
     flat = jnp.where(m.slot_valid[None], a, B)
     n_idx = jnp.arange(N)[:, None]
-    rf_u = m.rf[u][None, :]  # [1, H]
-    rf_v = m.rf[v][None, :]
+    rf_u = m.rf[u2]  # [N, H]
+    rf_v = m.rf[v2]
     su = (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % rf_u
     sv = (bits[..., 1] & u32(0x3FFFFFFF)).astype(i32) % rf_v
-    u2 = jnp.broadcast_to(u[None, :], su.shape)
-    v2 = jnp.broadcast_to(v[None, :], sv.shape)
     b_u = a[n_idx, u2, su]  # [N, H]
     b_v = a[n_idx, v2, sv]
 
     # legality: the incoming broker must not already sit in the row
     in_u = jnp.logical_and(flat[n_idx, u2] == b_v[..., None],
-                           m.slot_valid[u][None]).any(-1)
+                           m.slot_valid[u2]).any(-1)
     in_v = jnp.logical_and(flat[n_idx, v2] == b_u[..., None],
-                           m.slot_valid[v][None]).any(-1)
+                           m.slot_valid[v2]).any(-1)
     legal = ~jnp.logical_or(in_u, in_v)
 
     # objective delta (role-aware at both sites)
@@ -442,6 +446,13 @@ def make_sweep_solver_fn(
         a = jnp.broadcast_to(a_seed.astype(jnp.int32), (n_chains, P, R))
         w0, p0 = scores(m, a)
         best_k = best_key(w0, p0)  # seed snapshot: never return worse
+        # moves is the lexicographic tie-break: weight tiers alias move
+        # counts (keeping one leader == keeping two followers, 4 = 2+2),
+        # so equal-objective plans with different move counts exist and
+        # Metropolis wanders that plateau (delta >= 0 accepts). Tracking
+        # only the key keeps the FIRST plateau point found; the north
+        # star is fewest moves, so ties must prefer fewer.
+        best_mv = moves_batch(a, m)
         best_a = a
 
         if axis_name is not None:
@@ -451,10 +462,12 @@ def make_sweep_solver_fn(
                 return lax.pcast(x, axis_name, to="varying")
 
             key = to_varying(key)
-            a, best_k, best_a = jax.tree.map(to_varying, (a, best_k, best_a))
+            a, best_k, best_mv, best_a = jax.tree.map(
+                to_varying, (a, best_k, best_mv, best_a)
+            )
 
         def body(carry, xs):
-            a, best_k, best_a, key = carry
+            a, best_k, best_mv, best_a, key = carry
             temp, do_snap, do_exchange = xs
             key, sub = random.split(key)
             a = lax.cond(
@@ -465,10 +478,14 @@ def make_sweep_solver_fn(
             )
 
             def snap(args):
-                a, best_k, best_a = args
+                a, best_k, best_mv, best_a = args
                 w, pen = scores(m, a)
                 k = best_key(w, pen)
-                improved = k > best_k
+                mv = moves_batch(a, m)
+                improved = jnp.logical_or(
+                    k > best_k, jnp.logical_and(k == best_k, mv < best_mv)
+                )
+                best_mv = jnp.where(improved, mv, best_mv)
                 best_k = jnp.where(improved, k, best_k)
                 best_a = jnp.where(improved[:, None, None], a, best_a)
                 if axis_name is not None:
@@ -480,15 +497,23 @@ def make_sweep_solver_fn(
                     # owner-broadcast the chain engine runs every round
                     # (anneal.make_round_runner), amortized here to once
                     # per snapshot because a sweep moves every partition.
+                    imax = jnp.iinfo(jnp.int32).max
                     local_best = jnp.max(k)
                     global_best = lax.pmax(local_best, axis_name)
-                    idx = lax.axis_index(axis_name)
-                    am_owner = local_best == global_best
-                    owner = lax.pmin(
-                        jnp.where(am_owner, idx, jnp.iinfo(jnp.int32).max),
-                        axis_name,
+                    # lexicographic global winner: highest key, then
+                    # fewest moves among the key-tied chains
+                    local_mv = jnp.min(
+                        jnp.where(k == global_best, mv, imax)
                     )
-                    src = jnp.argmax(k)
+                    global_mv = lax.pmin(local_mv, axis_name)
+                    idx = lax.axis_index(axis_name)
+                    am_owner = jnp.logical_and(
+                        local_best == global_best, local_mv == global_mv
+                    )
+                    owner = lax.pmin(
+                        jnp.where(am_owner, idx, imax), axis_name
+                    )
+                    src = jnp.argmin(jnp.where(k == global_best, mv, imax))
                     cand = jnp.where(idx == owner, a[src],
                                      jnp.zeros_like(a[src]))
                     g = lax.psum(cand, axis_name)
@@ -498,17 +523,25 @@ def make_sweep_solver_fn(
                     # construction) — waiting for the next snapshot would
                     # make the final sweep's migration dead and leave
                     # short schedules with no propagation at all
-                    take = global_best > best_k[dst]
+                    take = jnp.logical_or(
+                        global_best > best_k[dst],
+                        jnp.logical_and(global_best == best_k[dst],
+                                        global_mv < best_mv[dst]),
+                    )
                     best_k = best_k.at[dst].max(global_best)
+                    best_mv = best_mv.at[dst].set(
+                        jnp.where(take, global_mv, best_mv[dst])
+                    )
                     best_a = best_a.at[dst].set(
                         jnp.where(take, g, best_a[dst])
                     )
-                return a, best_k, best_a
+                return a, best_k, best_mv, best_a
 
-            a, best_k, best_a = lax.cond(
-                do_snap, snap, lambda args: args, (a, best_k, best_a)
+            a, best_k, best_mv, best_a = lax.cond(
+                do_snap, snap, lambda args: args,
+                (a, best_k, best_mv, best_a)
             )
-            return (a, best_k, best_a, key), jnp.max(best_k)
+            return (a, best_k, best_mv, best_a, key), jnp.max(best_k)
 
         # snapshot every Nth sweep AND the final one: the coldest sweeps
         # improve the most and must never be discarded
@@ -519,10 +552,14 @@ def make_sweep_solver_fn(
         # odd sweeps run the count-invariant pair-exchange move; even
         # sweeps run single-site replace/lswap proposals
         do_exchange = jnp.arange(sweeps) % 2 == 1
-        (a, best_k, best_a, key), curve = lax.scan(
-            body, (a, best_k, best_a, key), (temps, do_snap, do_exchange)
+        (a, best_k, best_mv, best_a, key), curve = lax.scan(
+            body, (a, best_k, best_mv, best_a, key),
+            (temps, do_snap, do_exchange)
         )
-        top = jnp.argmax(best_k)
+        tied = best_k == jnp.max(best_k)
+        top = jnp.argmin(
+            jnp.where(tied, best_mv, jnp.iinfo(jnp.int32).max)
+        )
         return best_a[top], best_k[top], curve
 
     return solve

@@ -26,14 +26,21 @@ from ..models.instance import ProblemInstance
 
 
 def instance_fingerprint(inst: ProblemInstance) -> str:
-    """Stable digest of everything that defines candidate compatibility."""
+    """Stable digest of everything that defines candidate compatibility:
+    layout (brokers, racks, partitions, RF) AND the objective/constraint
+    data (current assignment a0, weight matrices, bands) — a checkpoint
+    must only resume onto the same *problem*, not just the same shapes
+    (ADVICE r1: a same-layout instance with a different current
+    assignment or different bands is a different problem, and silently
+    re-seeding from it would make the saved meta objective a lie)."""
     h = hashlib.sha256()
-    h.update(np.ascontiguousarray(inst.broker_ids).tobytes())
-    h.update(np.ascontiguousarray(inst.rack_of_broker).tobytes())
-    h.update(np.ascontiguousarray(inst.topic_of_part).tobytes())
-    h.update(np.ascontiguousarray(inst.part_id).tobytes())
-    h.update(np.ascontiguousarray(inst.rf).tobytes())
-    h.update(json.dumps(inst.topics).encode())
+    for arr in (inst.broker_ids, inst.rack_of_broker, inst.topic_of_part,
+                inst.part_id, inst.rf, inst.a0, inst.w_leader,
+                inst.w_follower, inst.rack_lo, inst.rack_hi,
+                inst.part_rack_hi):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update(json.dumps([inst.topics, inst.broker_lo, inst.broker_hi,
+                         inst.leader_lo, inst.leader_hi]).encode())
     return h.hexdigest()[:32]
 
 

@@ -25,7 +25,10 @@ def test_lp_text_structure(demo):
     text = emit_lp(inst)
     P, B, K = inst.num_parts, inst.num_brokers, inst.num_racks
 
-    # section headers present, in the reference order (README.md:144-185)
+    # section headers present, in the reference order (README.md:144-185);
+    # rack sections repeat per rack / per (partition, rack) with the name
+    # suffix the sample shows ("... per racks. tor02 here", README.md:173,
+    # "... p0 on tor02 here", README.md:178)
     headers = [ln for ln in text.splitlines() if ln.startswith("//")]
     assert headers == [
         "// Optimization function, based on current assignment ",
@@ -34,8 +37,11 @@ def test_lp_text_structure(demo):
         "// Constraint on min/max replicas per broker",
         "// Constraint on min/max leaders per broker",
         "// Constraint on no leader and replicas on the same broker",
-        "// Constrain on min/max total replicas per racks",
-        "// Constrain on min/max replicas per partitions per racks",
+        *[f"// Constrain on min/max total replicas per racks. {r} here"
+          for r in inst.rack_names],
+        *[f"// Constrain on min/max replicas per partitions per racks. "
+          f"p{p} on {r} here"
+          for p in range(P) for r in inst.rack_names],
         "// All variables are binary",
     ]
 

@@ -118,3 +118,75 @@ def test_handler_unit_surface():
     with pytest.raises(ApiError) as ei:
         handle_submit({"brokers": "0-3"})
     assert ei.value.status == 400
+
+
+def test_submit_rejects_path_valued_options(server_url):
+    """ADVICE r1 (medium): a remote client must not be able to forward
+    path-valued solver kwargs (checkpoint/profile_dir) — or any kwarg
+    outside the search-knob allowlist — through POST /submit."""
+    base = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    }
+    for bad in ({"checkpoint": "/tmp/evil.npz"},
+                {"profile_dir": "/tmp/evil"},
+                {"nonsense_knob": 1}):
+        status, body = post(server_url, {**base, "options": bad})
+        assert status == 400, (bad, body)
+        assert "unsupported option" in body["error"]
+
+
+def test_submit_busy_returns_503():
+    """VERDICT r1 item 9: a solve in flight must shed later requests
+    with 503 after a bounded wait, not queue them forever."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    }
+    assert srv_mod._SOLVE_LOCK.acquire(timeout=5)  # simulate a long solve
+    try:
+        with pytest.raises(ApiError) as ei:
+            handle_submit(payload, lock_wait_s=0.2)
+        assert ei.value.status == 503
+    finally:
+        srv_mod._SOLVE_LOCK.release()
+    # lock free again: the same request now succeeds
+    out = handle_submit(payload, lock_wait_s=0.2)
+    assert out["report"]["feasible"]
+
+
+def test_submit_server_caps_time_limit():
+    """The service injects its max solve budget; a client may tighten
+    the limit but never exceed it."""
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "tpu",
+        "options": {"batch": 8, "rounds": 4, "steps_per_round": 100,
+                    "time_limit_s": 9999.0},
+    }
+    out = handle_submit(payload, max_solve_s=60.0)
+    assert out["report"]["solver_time_limit_s"] == 60.0
+    payload["options"]["time_limit_s"] = 30.0
+    out = handle_submit(payload, max_solve_s=60.0)
+    assert out["report"]["solver_time_limit_s"] == 30.0
+
+
+def test_submit_time_limit_validation_and_no_mutation():
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+        "options": {"time_limit_s": "30"},
+    }
+    with pytest.raises(ApiError) as ei:
+        handle_submit(payload)
+    assert ei.value.status == 400
+    # the caller's dict is never mutated by the cap injection
+    payload["options"] = {}
+    handle_submit(payload, max_solve_s=60.0)
+    assert payload["options"] == {}

@@ -210,3 +210,37 @@ def test_sweep_solver_pallas_scorer_bit_identical(rng):
     assert k_x == k_p
     np.testing.assert_array_equal(a_x, a_p)
     np.testing.assert_array_equal(c_x, c_p)
+
+
+def test_sweep_pallas_scorer_inside_shard_map(rng):
+    """Regression for the r2 TPU bench crash: pallas_call's plain
+    ShapeDtypeStruct out_shapes have no vma annotation, which
+    jax>=0.9's shard_map varying-manual-axes check rejects — a failure
+    mode only the TPU path hit, because the Pallas scorer route is
+    TPU-only and every CPU test ran scorer='xla'. This runs the kernel
+    (interpret mode) through the production shard_map wrapper
+    (parallel.mesh, check_vma=False) on the 8-device CPU mesh and pins
+    trajectory parity with the XLA scorer across shards."""
+    from kafka_assignment_optimizer_tpu.parallel.mesh import (
+        best_of,
+        make_mesh,
+        solve_on_mesh,
+    )
+
+    current, brokers, topo = random_cluster(rng, 10, 16, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    mesh = make_mesh()
+    outs = {}
+    for scorer in ("xla", "pallas-interpret"):
+        pop_a, pop_k, _curve = solve_on_mesh(
+            m, seed, jax.random.PRNGKey(3), mesh,
+            chains_per_device=2, rounds=8, steps_per_round=1,
+            engine="sweep", scorer=scorer,
+        )
+        outs[scorer] = best_of(pop_a, pop_k)
+    a_x, k_x = outs["xla"]
+    a_p, k_p = outs["pallas-interpret"]
+    assert k_x == k_p
+    np.testing.assert_array_equal(a_x, a_p)

@@ -180,3 +180,43 @@ def test_no_time_limit_runs_all_rounds(rng):
     assert st["timed_out"] is False
     assert st["rounds_run"] == 6
     assert st["steps_per_round_ignored"] is False
+
+
+def test_mesh_size_invariance(rng):
+    """SURVEY.md §7 hard part 5 / VERDICT r1 item 8: the same instance +
+    seed solved over n_devices ∈ {1, 2, 8} must produce a feasible plan
+    of equivalent quality on every mesh size (no crash, no sharding bug,
+    no quality cliff). Trajectories legitimately differ — per-device RNG
+    streams depend on the mesh — so the pin is exact quality, not bytes:
+    this instance is exactly solvable, and every mesh size must reach
+    the ILP optimum."""
+    current, brokers, topo = random_cluster(rng, 8, 12, 2, 2, drop=1)
+    exact = optimize(current, brokers, topo, solver="milp")
+    for n_dev in (1, 2, 8):
+        res = optimize(current, brokers, topo, solver="tpu", seed=11,
+                       batch=24, rounds=10, steps_per_round=400,
+                       n_devices=n_dev)
+        rep = res.report()
+        assert rep["feasible"], (n_dev, rep)
+        assert res.replica_moves <= exact.replica_moves, (n_dev, rep)
+        assert res.solve.objective == exact.solve.objective, (n_dev, rep)
+
+
+def test_mesh_size_invariance_sweep_engine(rng):
+    """Same pin for the sweep engine (the at-scale path): forced
+    engine='sweep' across mesh sizes stays feasible and within one move
+    / one weight unit of the ILP optimum. Exactness is NOT pinned here:
+    a stochastic engine sized for 10k-partition instances can park in a
+    1-move local optimum on a 14-partition toy, and which mesh size does
+    so is a seed artifact, not a sharding bug (the chain-engine test
+    above pins exactness on the small-instance default path)."""
+    current, brokers, topo = random_cluster(rng, 10, 14, 2, 2, drop=1)
+    exact = optimize(current, brokers, topo, solver="milp")
+    for n_dev in (1, 2, 8):
+        res = optimize(current, brokers, topo, solver="tpu", seed=5,
+                       engine="sweep", batch=32, rounds=96,
+                       n_devices=n_dev)
+        rep = res.report()
+        assert rep["feasible"], (n_dev, rep)
+        assert res.replica_moves <= exact.replica_moves + 1, (n_dev, rep)
+        assert res.solve.objective >= exact.solve.objective - 1, (n_dev, rep)
