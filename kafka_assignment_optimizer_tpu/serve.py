@@ -282,6 +282,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="time limit injected into every solve; clients "
                          "may tighten but not exceed it (0 = uncapped)")
     args = ap.parse_args(argv)
+    if args.lock_wait_s < 0:
+        ap.error("--lock-wait-s must be >= 0")
+    if args.max_solve_s < 0:
+        ap.error("--max-solve-s must be >= 0 (0 = uncapped)")
     from .utils.platform import pin_platform
 
     pin_platform()
