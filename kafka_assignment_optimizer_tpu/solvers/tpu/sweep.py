@@ -28,6 +28,8 @@ bookkeeper.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 from jax import lax, random
@@ -106,22 +108,27 @@ def chain_scores(m: ModelArrays, a: jax.Array):
 
 
 def _make_scorer(scorer: str):
-    """Resolve the bulk-rescoring implementation for the sweep loop.
+    """Resolve the sweep loop's device implementations.
 
-    ``"xla"``: scatter-add histograms + dense algebra (the CPU/CI path).
-    ``"pallas"`` / ``"pallas-interpret"``: the tiled one-hot-matmul
-    Mosaic kernel (``ops.score_pallas``) — the TPU hot path VERDICT r1
-    items 2-3 call for; interpret mode exists so CI can execute the very
-    code path the TPU runs. Both return bit-identical integers (kernel
-    parity is asserted in tests), so the sweep trajectory is scorer-
-    independent.
+    ``"xla"``: scatter-add histograms + gather-based proposal algebra
+    (the CPU/CI path).
+    ``"pallas"`` / ``"pallas-interpret"``: the Mosaic hot path — the
+    tiled one-hot-matmul scoring kernel (``ops.score_pallas``) AND the
+    fused proposal kernel (``ops.propose_pallas``); interpret mode
+    exists so CI can execute the very code paths the TPU runs. Every
+    implementation returns bit-identical records (pinned in tests), so
+    the sweep trajectory is implementation-independent.
 
     Returns (hists(m, a) -> (flat, racks, cnt, lcnt, rcnt),
-             scores(m, a) -> (w [N], pen [N])).
+             scores(m, a) -> (w [N], pen [N]),
+             propose(m, a, bits, temp, hists=...) -> SiteProposals | None).
     """
     if scorer == "xla":
-        return _histograms, chain_scores
+        return _histograms, chain_scores, None
 
+    import functools
+
+    from ...ops.propose_pallas import propose_site_pallas
     from ...ops.score_pallas import score_batch_pallas
 
     interpret = scorer == "pallas-interpret"
@@ -138,46 +145,80 @@ def _make_scorer(scorer: str):
         pen = s.pen_broker + s.pen_leader + s.pen_rack + s.pen_part_rack
         return s.weight, pen.astype(jnp.int32)
 
-    return hists, scores
+    propose = functools.partial(propose_site_pallas, interpret=interpret)
+    return hists, scores, propose
 
 
 def best_key(w: jax.Array, pen: jax.Array) -> jax.Array:
     return jnp.where(pen == 0, w, -pen - 1)
 
 
-def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
-               hists=_histograms):
-    """One parallel annealing sweep over all chains and partitions.
-    ``hists`` supplies the from-scratch histograms (XLA scatter-adds by
-    default; the Pallas kernel on TPU via ``_make_scorer``)."""
+class SiteProposals(NamedTuple):
+    """One proposed move per (chain, partition), the unit the conflict
+    thinning and apply stages consume. Two move shapes share the record:
+
+    - replace (``is_lsw`` false): slot ``s`` <- ``b_new``; the outgoing
+      broker is ``b_at_s``.
+    - leader swap (``is_lsw`` true): slot 0 <- ``b_at_s`` (the promotee
+      at slot ``s``), slot ``s`` <- ``b_lead``; zero replica movement.
+
+    ``prio`` > 0 iff Metropolis-accepted; thinning keeps a proposal only
+    if it owns the priority maps of both brokers whose counts it moves.
+    """
+
+    is_lsw: jax.Array  # [N, P] bool
+    s: jax.Array       # [N, P] int32 target slot
+    b_new: jax.Array   # [N, P] int32 incoming broker (replace)
+    b_lead: jax.Array  # [N, P] int32 current leader (slot 0)
+    b_at_s: jax.Array  # [N, P] int32 current occupant of slot s
+    prio: jax.Array    # [N, P] float32, 0 where rejected
+
+
+def _rand_idx(u: jax.Array, hi: jax.Array) -> jax.Array:
+    """Uniform int in [0, hi) from u ~ U[0,1): floor(u * hi), clamped —
+    float32 rounding can land exactly on hi when u is close to 1. This
+    (not modulo) is the shared formulation because Mosaic has no vector
+    integer division; both the XLA and the Pallas proposal paths use it
+    so their trajectories stay bit-identical."""
+    hi_f = hi.astype(jnp.float32) if hasattr(hi, "astype") else float(hi)
+    return jnp.minimum((u * hi_f).astype(jnp.int32), hi - 1)
+
+
+def propose_site(m: ModelArrays, a: jax.Array, bits: jax.Array, temp,
+                 hists=_histograms) -> SiteProposals:
+    """Evaluate one single-site proposal per (chain, partition): pick the
+    move, compute its exact score delta against the sweep-start
+    histograms, Metropolis-accept, and draw the thinning priority.
+    ``bits [N, P, 8] uint32`` supplies all randomness (lane layout shared
+    with the Pallas kernel in ``ops.propose_pallas``, which reproduces
+    this function bit-for-bit)."""
     N, P, R = a.shape
     B = m.num_brokers
-    i32 = jnp.int32
-    u32 = jnp.uint32
 
     flat, racks, cnt, lcnt, rcnt = hists(m, a)
-    bits = random.bits(key, (N, P, 6), jnp.uint32)
     rf = m.rf[None, :]  # [1, P]
 
     # ---- proposal: slot + move type + incoming broker ----------------
-    s_rep = (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % rf
-    s_lsw = 1 + (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % jnp.maximum(
-        rf - 1, 1
-    )
+    u_slot = _u01(bits[..., 0])
+    s_rep = _rand_idx(u_slot, rf)
+    s_lsw = 1 + _rand_idx(u_slot, jnp.maximum(rf - 1, 1))
     is_lsw = jnp.logical_and(_u01(bits[..., 1]) < P_LSWAP, rf > 1)
     s = jnp.where(is_lsw, s_lsw, s_rep)  # [N, P]
 
     p_idx = jnp.arange(P)[None, :]
     n_idx = jnp.arange(N)[:, None]
-    b_old = a[n_idx, p_idx, jnp.where(is_lsw, 0, s)]  # replace: slot s;
-    # lswap: the leader loses leadership — model as (b_out, b_in) on lcnt
-    b_foll = a[n_idx, p_idx, s]  # lswap promotee (== b_old for replace? no)
+    b_lead = a[:, :, 0]
+    b_at_s = a[n_idx, p_idx, s]
+    # replace moves slot s's occupant out; lswap moves a leadership unit
+    # out of the current leader
+    b_old = jnp.where(is_lsw, b_lead, b_at_s)
+    b_foll = b_at_s  # lswap promotee
 
-    b_uni = (bits[..., 2] % u32(B)).astype(i32)
-    s_orig = (bits[..., 3] & u32(0xFFFF)).astype(i32) % R
+    b_uni = _rand_idx(_u01(bits[..., 2]), jnp.int32(B))
+    s_orig = _rand_idx(_u01(bits[..., 3]), jnp.int32(R))
     b_orig = m.a0[jnp.broadcast_to(p_idx, s_orig.shape), s_orig]  # [N, P]
     b_new = jnp.where(
-        jnp.logical_and(_u01(bits[..., 3]) < P_RESTORE, b_orig < B),
+        jnp.logical_and(_u01(bits[..., 4]) < P_RESTORE, b_orig < B),
         b_orig,
         b_uni,
     )
@@ -234,7 +275,6 @@ def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
     legal_rep = ~in_row
 
     # ---- deltas (lswap: promote slot s to leader) --------------------
-    b_lead = a[n_idx, p_idx, 0]
     dw_lsw = (
         m.w_lead[p_idx, b_foll] + m.w_foll[p_idx, b_lead]
         - m.w_lead[p_idx, b_lead] - m.w_foll[p_idx, b_foll]
@@ -256,41 +296,65 @@ def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
         legal,
         jnp.logical_or(
             delta >= 0,
-            _u01(bits[..., 4]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+            _u01(bits[..., 5]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
         ),
     )
 
-    # ---- conflict thinning: ≤1 accepted move per broker's counts -----
-    # tokens: replace moves an (out=b_old, in=b_new) unit; lswap moves a
-    # leadership unit (out=b_lead, in=b_foll). One shared priority map per
-    # direction bounds every histogram's drift to ±1 per broker per sweep.
-    prio = _u01(bits[..., 5]) + jnp.float32(1e-6)  # > 0
+    prio = _u01(bits[..., 6]) + jnp.float32(1e-6)  # > 0
     prio = jnp.where(accept, prio, 0.0)
-    tok_out = jnp.where(is_lsw, b_lead, b_old)
-    tok_in = jnp.where(is_lsw, b_foll, b_new)
-    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_out].max(prio)
-    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_in].max(prio)
+    return SiteProposals(is_lsw=is_lsw, s=s, b_new=b_new, b_lead=b_lead,
+                         b_at_s=b_at_s, prio=prio)
+
+
+def thin_apply(m: ModelArrays, a: jax.Array, p: SiteProposals) -> jax.Array:
+    """Conflict-thin accepted proposals (≤1 kept move per broker's counts
+    per direction) and apply the winners.
+
+    Tokens: replace moves an (out=b_at_s, in=b_new) replica unit; lswap
+    moves a leadership unit (out=b_lead, in=b_at_s). One shared
+    random-priority map per direction bounds every histogram's drift to
+    ±1 per broker per sweep."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    n_idx = jnp.arange(N)[:, None]
+    tok_out = jnp.where(p.is_lsw, p.b_lead, p.b_at_s)
+    tok_in = jnp.where(p.is_lsw, p.b_at_s, p.b_new)
+    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_out].max(p.prio)
+    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, tok_in].max(p.prio)
     keep = jnp.logical_and(
-        accept,
+        p.prio > 0,
         jnp.logical_and(
-            prio == m_out[n_idx, tok_out], prio == m_in[n_idx, tok_in]
+            p.prio == m_out[n_idx, tok_out], p.prio == m_in[n_idx, tok_in]
         ),
     )
 
-    # ---- apply (vectorized; one move max per partition) --------------
+    # apply (vectorized; one move max per partition)
     r_iota = jnp.arange(R)[None, None, :]
-    s3 = s[:, :, None]
+    s3 = p.s[:, :, None]
     keep3 = keep[:, :, None]
     # replace: slot s <- b_new
-    rep_val = jnp.where(r_iota == s3, b_new[:, :, None], a)
-    # lswap: slot 0 <- b_foll, slot s <- b_lead
+    rep_val = jnp.where(r_iota == s3, p.b_new[:, :, None], a)
+    # lswap: slot 0 <- promotee (b_at_s), slot s <- old leader
     lsw_val = jnp.where(
         r_iota == 0,
-        b_foll[:, :, None],
-        jnp.where(r_iota == s3, b_lead[:, :, None], a),
+        p.b_at_s[:, :, None],
+        jnp.where(r_iota == s3, p.b_lead[:, :, None], a),
     )
-    new_a = jnp.where(is_lsw[:, :, None], lsw_val, rep_val)
+    new_a = jnp.where(p.is_lsw[:, :, None], lsw_val, rep_val)
     return jnp.where(keep3, new_a, a)
+
+
+def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
+               hists=_histograms, propose=None):
+    """One parallel annealing sweep over all chains and partitions:
+    propose everywhere -> Metropolis accept -> conflict-thin -> apply.
+    ``hists`` supplies the from-scratch histograms and ``propose`` the
+    proposal evaluator (``propose_site`` in XLA by default; the fused
+    Pallas kernel on TPU via ``_make_scorer``)."""
+    N, P = a.shape[:2]
+    bits = random.bits(key, (N, P, 8), jnp.uint32)
+    prop = (propose or propose_site)(m, a, bits, temp, hists=hists)
+    return thin_apply(m, a, prop)
 
 
 def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
@@ -437,7 +501,7 @@ def make_sweep_solver_fn(
     is a runtime argument so clock-checked chunked solves reuse one
     executable. ``scorer`` selects the bulk-rescoring implementation
     (``_make_scorer``); every scorer yields bit-identical trajectories."""
-    hists, scores = _make_scorer(scorer)
+    hists, scores, propose = _make_scorer(scorer)
 
     def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
               temps: jax.Array):
@@ -473,7 +537,8 @@ def make_sweep_solver_fn(
             a = lax.cond(
                 do_exchange,
                 lambda a: exchange_sweep(m, a, sub, temp),
-                lambda a: sweep_once(m, a, sub, temp, hists=hists),
+                lambda a: sweep_once(m, a, sub, temp, hists=hists,
+                                     propose=propose),
                 a,
             )
 
