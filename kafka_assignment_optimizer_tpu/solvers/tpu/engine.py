@@ -91,8 +91,9 @@ def solve_tpu(
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
-    from ...utils.platform import ensure_backend
+    from ...utils.platform import enable_compile_cache, ensure_backend
 
+    enable_compile_cache()
     platform = ensure_backend()
     d = _defaults(inst, platform, engine)
     engine = d["engine"]

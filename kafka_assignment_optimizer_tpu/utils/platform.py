@@ -24,6 +24,39 @@ def pin_platform(platform: str | None = None) -> None:
         jax.config.update("jax_platforms", want)
 
 
+def enable_compile_cache() -> None:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    Measured on the r2 TPU host: the headline sweep executable costs
+    ~25 s to compile in a fresh process and ~4 s with a warm disk cache —
+    and the bench harness, the CLI, and the HTTP service each solve in
+    fresh processes, so cross-process reuse is the difference between a
+    60 s and a ~15 s cold start. Opt out with ``KAO_JIT_CACHE=off``;
+    override the location with ``KAO_JIT_CACHE=/path``."""
+    want = os.environ.get("KAO_JIT_CACHE", "")
+    if want.lower() in ("off", "0", "none"):
+        return
+    path = want or os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.expanduser("~/.cache")),
+        "kafka_assignment_optimizer_tpu", "jit",
+    )
+    import jax
+
+    if jax.config.jax_compilation_cache_dir != path:
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError as e:
+            # the cache is an optimization, never a precondition: a
+            # read-only $HOME (containerized service) must not fail solves
+            import sys
+
+            print(f"[kao] compile cache disabled ({e})", file=sys.stderr)
+            return
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 def ensure_backend() -> str:
     """Initialize a JAX backend, surviving a broken accelerator plugin.
 
