@@ -309,26 +309,58 @@ def build_instance(
     rack_lo = (r_tot * rack_sizes) // B
     rack_hi = -((-r_tot * rack_sizes) // B)
     part_rack_hi = -(-rf // K)
-    # consistency with the diversity cap (C10): with cap c_p per rack, any
-    # feasible plan puts between max(0, rf_p - c_p*(K-1)) and min(rf_p, c_p)
-    # replicas of partition p in each rack. With unequal rack sizes the
-    # proportional band can contradict that implied range (e.g. RF=2, K=2,
-    # cap=1 forces exactly P per rack); widen the band just enough to stay
-    # satisfiable. Equal-size racks reproduce the reference sample's exact
-    # bounds unchanged (README.md:173-176).
-    implied_lo = int(np.maximum(rf - part_rack_hi * (K - 1), 0).sum())
-    implied_hi = int(np.minimum(rf, part_rack_hi).sum())
-    rack_lo = np.minimum(rack_lo, implied_hi)
-    rack_hi = np.maximum(rack_hi, implied_lo)
-    # ... and the per-broker band must leave each rack's brokers enough
-    # combined capacity for the rack's forced minimum (and vice versa for
-    # the floor): e.g. a 3-broker rack forced to hold 10 replicas needs
-    # broker_hi >= ceil(10/3), whatever floor(R/B) says.
-    if K > 1:
-        forced_lo = np.maximum(rack_lo, implied_lo)
-        allowed_hi = np.minimum(rack_hi, implied_hi)
-        broker_hi = max(broker_hi, int(np.max(-(-forced_lo // rack_sizes))))
-        broker_lo = min(broker_lo, int(np.min(allowed_hi // rack_sizes)))
+
+    # --- satisfiability repair (balance bands are preferences: they must
+    # never make the instance infeasible). Equal-size racks satisfy every
+    # condition below as-is and reproduce the reference sample's exact
+    # bounds unchanged (README.md:173-176); lopsided topologies (found by
+    # the r2 property fuzz: a 1-broker rack + diversity caps can make the
+    # proportional ceilings under-supply r_tot, which the exact MILP
+    # reports as infeasible) get the minimal relaxation that admits a
+    # plan. Steps:
+    #   1. per-partition: the diversity cap c_p must allow rf_p replicas
+    #      across racks given each rack's broker count (uniqueness).
+    #   2. per-rack: tighten the band to the true implied extremes
+    #      [m_k, M_k] (no semantic change), then
+    #   3. jointly: relax ceilings/floors until sum(hi) covers r_tot and
+    #      sum(lo) <= r_tot.
+    #   4. broker bands: every rack's brokers must supply its floor, and
+    #      the global per-broker supply must cover r_tot under the rack
+    #      ceilings.
+    cap_pk = np.minimum(part_rack_hi[:, None], rack_sizes[None, :])
+    short = rf - cap_pk.sum(1)
+    while (short > 0).any():  # step 1 (terminates: B >= rf checked)
+        part_rack_hi = part_rack_hi + (short > 0)
+        cap_pk = np.minimum(part_rack_hi[:, None], rack_sizes[None, :])
+        short = rf - cap_pk.sum(1)
+    M = cap_pk.sum(0)  # [K] true max replicas rack k can hold
+    m = np.maximum(  # [K] forced minimum (others at their caps)
+        rf[:, None] - (cap_pk.sum(1)[:, None] - cap_pk), 0
+    ).sum(0)
+    rack_hi = np.maximum(np.minimum(rack_hi, M), m)  # step 2 (m <= M, so
+    rack_lo = np.maximum(np.minimum(rack_lo, rack_hi), m)  # lo <= hi holds)
+    # steps 3a/3b converge in <= K+1 passes: every non-final pass clips
+    # at least one rack at its extreme
+    for _ in range(K + 1):  # step 3a: raise ceilings toward M
+        deficit = r_tot - int(rack_hi.sum())
+        head = M - rack_hi
+        if deficit <= 0 or not (head > 0).any():
+            break
+        add = -(-deficit // max(int((head > 0).sum()), 1))
+        rack_hi = np.minimum(rack_hi + np.where(head > 0, add, 0), M)
+    for _ in range(K + 1):  # step 3b: lower floors toward m
+        excess = int(rack_lo.sum()) - r_tot
+        slack = rack_lo - m
+        if excess <= 0 or not (slack > 0).any():
+            break
+        sub = -(-excess // max(int((slack > 0).sum()), 1))
+        rack_lo = np.maximum(rack_lo - np.where(slack > 0, sub, 0), m)
+    # step 4: per-broker band vs rack floors/ceilings
+    broker_hi = max(broker_hi, int(np.max(-(-rack_lo // rack_sizes))))
+    supply = lambda h: int(np.minimum(rack_sizes * h, rack_hi).sum())  # noqa: E731
+    while supply(broker_hi) < r_tot and broker_hi < r_tot:
+        broker_hi += 1
+    broker_lo = min(broker_lo, int(np.min(rack_hi // rack_sizes)))
 
     inst = ProblemInstance(
         broker_ids=broker_ids,

@@ -90,11 +90,16 @@ def test_instance_shapes_and_bounds_demo():
     np.testing.assert_array_equal(
         np.sort(np.bincount(inst.rack_of_broker[:19])), [9, 10]
     )
-    # proportional bounds: a: 20*10/19 in [10, 11]; b: 20*9/19 in [9, 10]
+    # proportional bounds tightened to the diversity-implied extremes:
+    # the per-partition cap of 1 bounds each rack at P = 10 total AND
+    # forces >= 1 replica per partition in each rack (the other rack is
+    # capped), so both bands collapse to exactly [10, 10] — the same
+    # exact-band shape the reference sample shows for its equal-rack
+    # case (README.md:173-176)
     a_idx = inst.rack_names.index("a")
     b_idx = inst.rack_names.index("b")
-    assert (inst.rack_lo[a_idx], inst.rack_hi[a_idx]) == (10, 11)
-    assert (inst.rack_lo[b_idx], inst.rack_hi[b_idx]) == (9, 10)
+    assert (inst.rack_lo[a_idx], inst.rack_hi[a_idx]) == (10, 10)
+    assert (inst.rack_lo[b_idx], inst.rack_hi[b_idx]) == (10, 10)
     # README.md:178-180 -> per-partition per-rack <= ceil(2/2) = 1
     assert (inst.part_rack_hi == 1).all()
 

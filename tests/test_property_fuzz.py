@@ -1,0 +1,148 @@
+"""Property fuzz (SURVEY.md §4.3, widened in r2): random clusters across
+the full input space — multiple topics, per-topic RF, unequal racks,
+broker add AND remove, RF changes — through the full ``optimize`` stack.
+Every emitted plan must satisfy C4–C10 exactly (the report's violation
+counts are computed by the numpy oracle, the ground truth)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+
+
+def random_messy_cluster(rng):
+    """A deliberately irregular cluster: several topics with different
+    partition counts and RFs, a lopsided rack map, and a broker list
+    that both removes and adds brokers vs the current assignment."""
+    n_brokers = int(rng.integers(6, 16))
+    n_topics = int(rng.integers(1, 4))
+    parts = []
+    for t in range(n_topics):
+        rf = int(rng.integers(1, min(4, n_brokers) + 1))
+        for p in range(int(rng.integers(2, 9))):
+            reps = rng.choice(n_brokers, size=rf, replace=False)
+            parts.append(
+                PartitionAssignment(f"topic-{t}", p, [int(b) for b in reps])
+            )
+    # lopsided racks: rack 0 gets ~half the brokers, the rest spread
+    n_racks = int(rng.integers(1, 4))
+    add = int(rng.integers(0, 3))  # brand-new brokers joining
+    all_ids = list(range(n_brokers + add))
+    rack_of = {
+        b: f"rack{0 if b % 4 < 2 else (b % n_racks)}" for b in all_ids
+    }
+    drop = int(rng.integers(0, 2))
+    brokers = all_ids[drop:]  # maybe remove broker 0, maybe add new ones
+    target_rf = None
+    if rng.random() < 0.3:
+        target_rf = int(rng.integers(1, 4))  # global RF change
+    return (Assignment(partitions=parts), brokers,
+            Topology(rack_of=rack_of), target_rf)
+
+
+@pytest.mark.parametrize("case_seed", range(8))
+def test_random_messy_clusters_all_constraints_hold(case_seed):
+    rng = np.random.default_rng(1000 + case_seed)
+    current, brokers, topo, target_rf = random_messy_cluster(rng)
+    max_rf = max(len(p.replicas) for p in current.partitions)
+    want_rf = target_rf or max_rf
+    if want_rf > len(brokers):
+        pytest.skip("RF exceeds broker count — rejected by the model")
+    res = optimize(current, brokers, topo, target_rf=target_rf,
+                   solver="tpu", batch=16, rounds=12,
+                   steps_per_round=300, seed=case_seed)
+    rep = res.report()
+    assert rep["feasible"], rep["violations"]
+    got = {(p.topic, p.partition): p.replicas
+           for p in res.assignment.partitions}
+    for p in current.partitions:
+        reps = got[(p.topic, p.partition)]
+        rf = target_rf or len(p.replicas)
+        assert len(reps) == rf, (p.topic, p.partition, reps)
+        assert len(set(reps)) == rf  # per-broker uniqueness
+        assert set(reps) <= set(brokers)  # eligibility
+
+
+@pytest.mark.parametrize("case_seed", range(4))
+def test_sweep_engine_on_messy_clusters(case_seed):
+    """Force the at-scale engine onto irregular small instances — the
+    shapes it never sees in production are where padding/rounding bugs
+    hide (odd partition counts vs the 2-way pairing, rf=1 rows, unequal
+    racks vs the kernel's K+1 null-rack algebra)."""
+    rng = np.random.default_rng(2000 + case_seed)
+    current, brokers, topo, target_rf = random_messy_cluster(rng)
+    max_rf = max(len(p.replicas) for p in current.partitions)
+    if (target_rf or max_rf) > len(brokers):
+        pytest.skip("RF exceeds broker count")
+    res = optimize(current, brokers, topo, target_rf=target_rf,
+                   solver="tpu", engine="sweep", batch=8, rounds=32,
+                   seed=case_seed)
+    assert res.report()["feasible"], res.report()["violations"]
+
+
+def test_sweep_engine_kernel_path_on_messy_cluster():
+    """The Mosaic code paths (interpret mode) on an irregular instance:
+    same plan as the XLA path, byte-for-byte."""
+    rng = np.random.default_rng(3000)
+    current, brokers, topo, target_rf = random_messy_cluster(rng)
+    max_rf = max(len(p.replicas) for p in current.partitions)
+    if (target_rf or max_rf) > len(brokers):  # pragma: no cover - seed-dep
+        pytest.skip("RF exceeds broker count")
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_assignment_optimizer_tpu import build_instance
+    from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+    from kafka_assignment_optimizer_tpu.solvers.tpu.arrays import (
+        geometric_temps,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        make_sweep_solver_fn,
+    )
+
+    inst = build_instance(current, brokers, topo, target_rf)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    temps = geometric_temps(2.0, 0.02, 12)
+    outs = {}
+    for scorer in ("xla", "pallas-interpret"):
+        solve = jax.jit(make_sweep_solver_fn(n_chains=4, scorer=scorer))
+        ba, bk, _ = solve(m, seed, jax.random.PRNGKey(1), temps)
+        outs[scorer] = (np.asarray(ba), int(bk))
+    np.testing.assert_array_equal(outs["xla"][0],
+                                  outs["pallas-interpret"][0])
+    assert outs["xla"][1] == outs["pallas-interpret"][1]
+
+
+def test_mixed_rf_lopsided_racks_band_not_inverted():
+    """r2 review reproduction: a tiny rack whose forced minimum (from
+    many rf=K partitions) exceeds its proportional ceiling must get the
+    ceiling RAISED, not an inverted [lo > hi] band that makes every
+    instance bound-infeasible by construction."""
+    from kafka_assignment_optimizer_tpu import build_instance
+
+    parts = []
+    for p in range(10):  # rf=3 over 3 racks: 1 replica forced per rack
+        parts.append(PartitionAssignment("t3", p, [0, 1, 9]))
+    for p in range(100):  # rf=1 filler drives the proportional shares up
+        parts.append(PartitionAssignment("t1", p, [1 + (p % 16)]))
+    rack_of = {0: "a"}
+    rack_of.update({b: "b" for b in range(1, 9)})
+    rack_of.update({b: "c" for b in range(9, 17)})
+    inst = build_instance(Assignment(partitions=parts), list(range(17)),
+                          Topology(rack_of=rack_of))
+    assert (inst.rack_lo <= inst.rack_hi).all(), (
+        inst.rack_lo, inst.rack_hi
+    )
+    # and the bands admit a plan: the exact solver must find one
+    res = optimize(Assignment(partitions=parts), list(range(17)),
+                   Topology(rack_of=rack_of), solver="milp")
+    assert res.report()["feasible"]
