@@ -355,3 +355,192 @@ def propose_site_pallas(m: ModelArrays, a: jax.Array, bits: jax.Array,
     )
     return SiteProposals(is_lsw=islsw.astype(bool), s=s, b_new=bnew,
                          b_lead=blead, b_at_s=bats, prio=prio)
+
+
+# ---------------------------------------------------------------------------
+# exchange halves: the pair-exchange move's per-partition delta half
+# (``sweep._exchange_halves_xla`` reproduced bit-for-bit), same layout
+# discipline as the proposal kernel
+# ---------------------------------------------------------------------------
+
+
+def _exchange_kernel(
+    a_ref,       # [1, R, TP] int32 candidate tile, partitions in lanes
+    rf_ref,      # [1, TP] int32
+    prh_ref,     # [1, TP] int32
+    wl_ref,      # [B1, TP] int32 leader weights, transposed
+    wf_ref,      # [B1, TP] int32 follower weights, transposed
+    rackof_ref,  # [B1, 1] int32
+    lim_ref,     # [1, 4] int32
+    sown_ref,    # [1, TP] int32 own slot
+    lother_ref,  # [1, TP] int32 partner slot is the leader slot (0/1)
+    bother_ref,  # [1, TP] int32 incoming broker
+    lcnt_ref,    # [B1, N] int32 leader histograms, all chains
+    # outputs ([1, 1, TP] blocks)
+    o_bown_ref,
+    o_dw_ref,
+    o_ddiv_ref,
+    o_dlcnt_ref,
+    o_legal_ref,
+):
+    B1, TP = wl_ref.shape
+    R = a_ref.shape[1]
+    B = B1 - 1
+    i32 = jnp.int32
+
+    n = pl.program_id(0)
+    NN = lcnt_ref.shape[1]
+    sel = (jax.lax.broadcasted_iota(i32, (1, NN), 1) == n).astype(i32)
+    lcnt_col = (lcnt_ref[...] * sel).sum(1, keepdims=True)  # [B1, 1]
+
+    rf = rf_ref[...]
+    s_own = sown_ref[0]          # [1, TP] (blocks are [1, 1, TP])
+    lead_other = lother_ref[0] > 0
+    b_other = bother_ref[0]
+    a = a_ref[0]  # [R, TP]
+
+    b_own = jnp.zeros_like(b_other)
+    for r in range(R):
+        b_own = jnp.where(s_own == r, a[r:r + 1, :], b_own)
+
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+
+    def oh(b):
+        return (b == iota_b).astype(i32)
+
+    def lut(tab, ohb):
+        return (ohb * tab).sum(axis=0, keepdims=True)
+
+    oh_own = oh(b_own)
+    oh_oth = oh(b_other)
+
+    # objective half
+    lead_own = s_own == 0
+    dw_own = jnp.where(
+        lead_own,
+        lut(wl_ref[...], oh_oth) - lut(wl_ref[...], oh_own),
+        lut(wf_ref[...], oh_oth) - lut(wf_ref[...], oh_own),
+    )
+
+    # pair-level leader-count term
+    lim = lim_ref[...]
+    llo, lhi = lim[0, 2], lim[0, 3]
+    xor = lead_own != lead_other
+    l_out = jnp.where(lead_own, b_own, b_other)
+    l_in = jnp.where(lead_own, b_other, b_own)
+    lo_c = lut(lcnt_col, oh(l_out))
+    li_c = lut(lcnt_col, oh(l_in))
+    dlcnt = jnp.where(
+        xor,
+        _band(lo_c - 1, llo, lhi) - _band(lo_c, llo, lhi)
+        + _band(li_c + 1, llo, lhi) - _band(li_c, llo, lhi),
+        0,
+    )
+
+    # diversity half + row legality, from the own row
+    r_out = lut(rackof_ref[...], oh_own)
+    r_in = lut(rackof_ref[...], oh_oth)
+    c_out = jnp.zeros_like(r_out)
+    c_in = jnp.zeros_like(r_in)
+    in_row = jnp.zeros_like(r_out)
+    for r in range(R):
+        live = r < rf
+        flat_r = jnp.where(live, a[r:r + 1, :], B)
+        rack_r = lut(rackof_ref[...], oh(flat_r))
+        c_out = c_out + (rack_r == r_out).astype(i32)
+        c_in = c_in + (rack_r == r_in).astype(i32)
+        in_row = in_row + (flat_r == b_other).astype(i32)
+    cap = prh_ref[...]
+
+    def g(c):
+        return jnp.maximum(c - cap, 0)
+
+    ddiv = jnp.where(
+        r_out != r_in,
+        g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in),
+        0,
+    )
+
+    o_bown_ref[0] = b_own
+    o_dw_ref[0] = dw_own
+    o_ddiv_ref[0] = ddiv
+    o_dlcnt_ref[0] = dlcnt
+    o_legal_ref[0] = (in_row == 0).astype(i32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _exchange_call(a, lcnt, s_own, lead_other, b_other, rf, prh, wl, wf,
+                   rackof, lim, *, interpret: bool):
+    N, P, R = a.shape
+    B1 = wl.shape[0]
+    tp = min(_TP, max(128, -(-P // 128) * 128))
+
+    aT = _pad_lanes(jnp.swapaxes(a, 1, 2), tp, B1 - 1)
+    rf_p = _pad_lanes(rf[None, :], tp, 1)
+    prh_p = _pad_lanes(prh[None, :], tp, 1)
+    wlT = _pad_lanes(wl, tp, 0)
+    wfT = _pad_lanes(wf, tp, 0)
+    sown = _pad_lanes(s_own[:, None, :], tp, 0)      # [N, 1, Pp]
+    loth = _pad_lanes(lead_other[:, None, :], tp, 0)
+    both = _pad_lanes(b_other[:, None, :], tp, 0)
+    lcntT = jnp.swapaxes(lcnt, 0, 1)
+
+    Pp = aT.shape[-1]
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+
+    outs = pl.pallas_call(
+        _exchange_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 4), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm)
+            for _ in range(5)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(aT, rf_p, prh_p, wlT, wfT, rackof, lim, sown, loth, both, lcntT)
+    return tuple(o[:, 0, :P] for o in outs)
+
+
+def exchange_halves_pallas(m: ModelArrays, a, lcnt, s_own, lead_other,
+                           b_other, b_own=None, *,
+                           interpret: bool = False):
+    """Drop-in replacement for ``sweep._exchange_halves_xla`` —
+    bit-identical half-deltas, fused in VMEM. ``b_own`` is accepted for
+    interface parity and ignored: the kernel rebuilds it from the tile,
+    where the R-way select costs nothing."""
+    del b_own
+    lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(
+        jnp.int32
+    )[None]
+    b_own, dw, ddiv, dlcnt, legal = _exchange_call(
+        a, lcnt, s_own.astype(jnp.int32),
+        lead_other.astype(jnp.int32), b_other,
+        m.rf, m.part_rack_hi.astype(jnp.int32),
+        jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
+        jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),
+        m.rack_of.astype(jnp.int32)[:, None],
+        lim,
+        interpret=interpret,
+    )
+    return b_own, dw, ddiv, dlcnt, legal > 0

@@ -121,14 +121,18 @@ def _make_scorer(scorer: str):
 
     Returns (hists(m, a) -> (flat, racks, cnt, lcnt, rcnt),
              scores(m, a) -> (w [N], pen [N]),
-             propose(m, a, bits, temp, hists=...) -> SiteProposals | None).
+             propose(m, a, bits, temp, hists=...) -> SiteProposals | None,
+             halves(...) -> exchange half-deltas | None).
     """
     if scorer == "xla":
-        return _histograms, chain_scores, None
+        return _histograms, chain_scores, None, None
 
     import functools
 
-    from ...ops.propose_pallas import propose_site_pallas
+    from ...ops.propose_pallas import (
+        exchange_halves_pallas,
+        propose_site_pallas,
+    )
     from ...ops.score_pallas import score_batch_pallas
 
     interpret = scorer == "pallas-interpret"
@@ -146,7 +150,8 @@ def _make_scorer(scorer: str):
         return s.weight, pen.astype(jnp.int32)
 
     propose = functools.partial(propose_site_pallas, interpret=interpret)
-    return hists, scores, propose
+    halves = functools.partial(exchange_halves_pallas, interpret=interpret)
+    return hists, scores, propose, halves
 
 
 def best_key(w: jax.Array, pen: jax.Array) -> jax.Array:
@@ -357,135 +362,245 @@ def sweep_once(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
     return thin_apply(m, a, prop)
 
 
-def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
-    """Cross-partition replica exchange — the count-invariant move.
+class ExchangeProposals(NamedTuple):
+    """One proposed pair-exchange per (chain, partition), partition-
+    aligned: partition p offers its slot-``s`` occupant ``b_own`` and
+    receives its partner's ``b_other``. Both halves of a pair carry
+    IDENTICAL ``prio`` (the pair's shared draw), so thinning and apply
+    reach the same decision on both sides without communication."""
 
-    Under exact-equality bands (lo == hi on broker/rack totals, common
-    when sizes divide evenly) single-site replaces always pass through a
-    penalized state and freeze out at low temperature; redistribution
-    then needs swaps that leave every per-broker and per-rack total
-    untouched (the chain engine's ``xswap``). Parallel form: a fresh
-    random permutation pairs the partitions each sweep — every partition
-    belongs to exactly ONE pair, so pair moves are conflict-free by
-    construction — and each pair proposes swapping one replica slot.
-    Only leader-count and per-partition diversity penalties can change;
-    both are evaluated exactly within the pair.
-    """
+    s: jax.Array        # [N, P] int32 own slot
+    b_own: jax.Array    # [N, P] int32 outgoing broker
+    b_other: jax.Array  # [N, P] int32 incoming broker
+    tok_out: jax.Array  # [N, P] int32 leadership token out (B = none)
+    tok_in: jax.Array   # [N, P] int32 leadership token in (B = none)
+    prio: jax.Array     # [N, P] float32, 0 where rejected
+
+
+def _pair_partners(key, N: int, P: int):
+    """Involution pairing by random stride: alternating d-blocks pair p
+    with p+d (lower blocks) / p-d (upper blocks). The stride d is shared
+    by all chains so partner-aligned views are two contiguous rolls
+    instead of gathers (XLA TPU gathers cost ~2-5 ms per [N, P] operand;
+    rolls are DMA copies); a per-chain random PHASE shifts the block
+    boundaries so chains still explore different pair structures
+    (ADVICE r1). Over sweeps d varies uniformly, so every pair distance
+    is eventually proposed; tail partitions whose partner falls off the
+    end sit out for one sweep.
+
+    Returns (d scalar, is_lower [N, P], pair_valid [N, P])."""
+    kd, kph = random.split(key)
+    # stride capped at P//2: longer distances compose from short strides
+    # over sweeps, while d ~ U[1, P-1] would bench ~half the partitions
+    # per sweep (pair_valid is false for ~d of P positions)
+    d = random.randint(kd, (), 1, max(P // 2, 2))
+    phase = random.randint(kph, (N, 1), 0, 2 * d)
+    p_idx = jnp.arange(P)[None, :]
+    is_lower = ((p_idx + phase) // d) % 2 == 0
+    partner = jnp.where(is_lower, p_idx + d, p_idx - d)
+    pair_valid = jnp.logical_and(partner >= 0, partner < P)
+    return d, is_lower, pair_valid
+
+
+def _partner_view(x, d, is_lower):
+    """x[n, partner(p), ...] for partner = p ± d — two rolls + select,
+    no gather. Out-of-range partners wrap; callers mask with
+    ``pair_valid``."""
+    up = jnp.roll(x, -d, axis=1)      # x[p + d]
+    down = jnp.roll(x, d, axis=1)     # x[p - d]
+    sel = is_lower
+    while sel.ndim < x.ndim:
+        sel = sel[..., None]
+    return jnp.where(sel, up, down)
+
+
+def _exchange_halves_xla(m: ModelArrays, a, lcnt, s_own, lead_other,
+                         b_other, b_own=None):
+    """Per-partition half of a pair-exchange delta, from the OWN row only
+    (plus the pair-level leader-count term, identical on both sides).
+    The Pallas kernel (``ops.propose_pallas.exchange_halves_pallas``)
+    reproduces this bit-for-bit. ``b_own`` (the slot occupant) may be
+    passed in when the caller already computed it; the kernel always
+    rebuilds it in VMEM where the select is free. Returns (b_own,
+    dw_own, ddiv_own, dlcnt_pair, legal_own)."""
     N, P, R = a.shape
     B = m.num_brokers
-    i32 = jnp.int32
-    u32 = jnp.uint32
-    H = P // 2
-    if H == 0:
-        return a
-
-    kperm, kbits = random.split(key)
-    # independent pairing per chain (ADVICE r1): one permutation shared
-    # by all N chains would give every chain identical pair structure
-    # each sweep, collapsing cross-chain diversity of this move type
-    perms = jax.vmap(random.permutation, in_axes=(0, None))(
-        random.split(kperm, N), P
-    )  # [N, P]
-    u2 = perms[:, :H]  # [N, H] first of each pair
-    v2 = perms[:, H : 2 * H]
-    bits = random.bits(kbits, (N, H, 4), jnp.uint32)
-
-    flat = jnp.where(m.slot_valid[None], a, B)
+    p_idx = jnp.arange(P)[None, :]
     n_idx = jnp.arange(N)[:, None]
-    rf_u = m.rf[u2]  # [N, H]
-    rf_v = m.rf[v2]
-    su = (bits[..., 0] & u32(0x3FFFFFFF)).astype(i32) % rf_u
-    sv = (bits[..., 1] & u32(0x3FFFFFFF)).astype(i32) % rf_v
-    b_u = a[n_idx, u2, su]  # [N, H]
-    b_v = a[n_idx, v2, sv]
 
-    # legality: the incoming broker must not already sit in the row
-    in_u = jnp.logical_and(flat[n_idx, u2] == b_v[..., None],
-                           m.slot_valid[u2]).any(-1)
-    in_v = jnp.logical_and(flat[n_idx, v2] == b_u[..., None],
-                           m.slot_valid[v2]).any(-1)
-    legal = ~jnp.logical_or(in_u, in_v)
+    if b_own is None:
+        r_iota = jnp.arange(R)[None, None, :]
+        b_own = (jnp.where(r_iota == s_own[:, :, None], a, 0)).sum(-1)
 
-    # objective delta (role-aware at both sites)
-    lead_u = su == 0
-    lead_v = sv == 0
-
-    def role_w(p2, b, lead):
-        return jnp.where(lead, m.w_lead[p2, b], m.w_foll[p2, b])
-
-    dw = (
-        role_w(u2, b_v, lead_u) - role_w(u2, b_u, lead_u)
-        + role_w(v2, b_u, lead_v) - role_w(v2, b_v, lead_v)
+    # objective half: replace own slot occupant b_own by b_other
+    lead_own = s_own == 0
+    dw_own = jnp.where(
+        lead_own,
+        m.w_lead[p_idx, b_other] - m.w_lead[p_idx, b_own],
+        m.w_foll[p_idx, b_other] - m.w_foll[p_idx, b_own],
     )
 
-    # leader-count delta only when exactly one slot is a leader slot
+    # leader-count term, pair-level (both sides compute the same value):
+    # with exactly one leader slot in the pair, a leadership unit moves
+    # from the broker at that slot to the broker arriving into it
     llo, lhi = m.leader_band[0], m.leader_band[1]
-    lcnt = jnp.zeros((N, B + 1), jnp.int32).at[
-        jnp.arange(N)[:, None], flat[:, :, 0]
-    ].add(1)
-    l_out = jnp.where(lead_u, b_u, b_v)
-    l_in = jnp.where(lead_u, b_v, b_u)
-    xor = jnp.logical_xor(lead_u, lead_v)
+    xor = lead_own != lead_other
+    l_out = jnp.where(lead_own, b_own, b_other)
+    l_in = jnp.where(lead_own, b_other, b_own)
     lo_c = lcnt[n_idx, l_out]
     li_c = lcnt[n_idx, l_in]
-    d_lcnt = jnp.where(
+    dlcnt = jnp.where(
         xor,
         _band_pen(lo_c - 1, llo, lhi) - _band_pen(lo_c, llo, lhi)
         + _band_pen(li_c + 1, llo, lhi) - _band_pen(li_c, llo, lhi),
         0,
     )
 
-    # per-partition diversity deltas at both sites
-    racks = m.rack_of[flat]
-    r_bu = m.rack_of[b_u]
-    r_bv = m.rack_of[b_v]
-    cross = r_bu != r_bv
+    # diversity half: own row loses rack(b_own), gains rack(b_other)
+    flat = jnp.where(m.slot_valid[None], a, B)
+    racks = m.rack_of[flat]  # [N, P, R]
+    r_out = m.rack_of[b_own]
+    r_in = m.rack_of[b_other]
+    c_out = (racks == r_out[:, :, None]).sum(-1)
+    c_in = (racks == r_in[:, :, None]).sum(-1)
+    cap = m.part_rack_hi[None, :]
 
-    def div_delta(p2, r_out, r_in):
-        rk = racks[n_idx, p2]  # [N, H, R]
-        c_out = (rk == r_out[..., None]).sum(-1)
-        c_in = (rk == r_in[..., None]).sum(-1)
-        cap = m.part_rack_hi[p2]
+    def g(c):
+        return jnp.maximum(c - cap, 0)
 
-        def g(c):
-            return jnp.maximum(c - cap, 0)
-
-        return g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in)
-
-    d_div = jnp.where(
-        cross, div_delta(u2, r_bu, r_bv) + div_delta(v2, r_bv, r_bu), 0
+    ddiv_own = jnp.where(
+        r_out != r_in,
+        g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in),
+        0,
     )
 
-    delta = (SCALE_W * dw - LAMBDA * (d_lcnt + d_div)).astype(jnp.float32)
+    # legality half: the incoming broker must not already sit in the row
+    in_row = jnp.logical_and(
+        flat == b_other[:, :, None], m.slot_valid[None]
+    ).any(-1)
+    return b_own, dw_own, ddiv_own, dlcnt, ~in_row
+
+
+def propose_exchange(m: ModelArrays, a, key, temp,
+                     halves=None) -> ExchangeProposals:
+    """Evaluate one pair-exchange proposal per (chain, partition). The
+    key drives the per-chain stride and a ``bits [N, P, 4]`` tensor
+    (lanes: slot-lower, slot-upper, metropolis, prio); the pair's shared
+    draws are the LOWER side's bits, so both halves reach identical
+    accept/priority decisions."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    # only leader counts can change under an exchange — one scatter, not
+    # the full scorer
+    n_idx0 = jnp.arange(N)[:, None]
+    lead = jnp.where(m.rf[None, :] > 0, a[:, :, 0], B)
+    lcnt = jnp.zeros((N, B + 1), jnp.int32).at[n_idx0, lead].add(1)
+
+    kd, kbits = random.split(key)
+    bits = random.bits(kbits, (N, P, 4), jnp.uint32)
+    d, is_lower, pair_valid = _pair_partners(kd, N, P)
+
+    bits_low = jnp.where(is_lower[..., None], bits,
+                         _partner_view(bits, d, is_lower))
+    u0 = _u01(bits_low[..., 0])
+    u1 = _u01(bits_low[..., 1])
+    rf_own = jnp.broadcast_to(m.rf[None, :], (N, P))
+    rf_other = jnp.broadcast_to(
+        jnp.where(is_lower, jnp.roll(m.rf, -d)[None, :],
+                  jnp.roll(m.rf, d)[None, :]),
+        (N, P),
+    )
+    s_own = _rand_idx(jnp.where(is_lower, u0, u1), rf_own)
+    s_other = _rand_idx(jnp.where(is_lower, u1, u0), rf_other)
+    lead_other = s_other == 0
+
+    b_probe = (jnp.where(
+        jnp.arange(R)[None, None, :] == s_own[:, :, None], a, 0
+    )).sum(-1)
+    b_other = _partner_view(b_probe, d, is_lower)
+
+    b_own, dw_own, ddiv_own, dlcnt, legal_own = (
+        halves or _exchange_halves_xla
+    )(m, a, lcnt, s_own, lead_other, b_other, b_own=b_probe)
+
+    # combine the halves (partner-aligned rolls of the packed trio)
+    packed = jnp.stack(
+        [dw_own, ddiv_own, legal_own.astype(jnp.int32)], axis=-1
+    )
+    other = _partner_view(packed, d, is_lower)
+    dw = dw_own + other[..., 0]
+    ddiv = ddiv_own + other[..., 1]
+    legal = jnp.logical_and(
+        jnp.logical_and(legal_own, other[..., 2] > 0), pair_valid
+    )
+    delta = (SCALE_W * dw - LAMBDA * (dlcnt + ddiv)).astype(jnp.float32)
     accept = jnp.logical_and(
         legal,
         jnp.logical_or(
             delta >= 0,
-            _u01(bits[..., 2]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+            _u01(bits_low[..., 2]) < jnp.exp(
+                delta / jnp.maximum(temp, 1e-6)
+            ),
         ),
     )
+    prio = jnp.where(accept, _u01(bits_low[..., 3]) + jnp.float32(1e-6),
+                     0.0)
 
-    # thinning only for the leader-count tokens (pairs are otherwise
-    # independent); token B (null) bypasses the map
-    prio = _u01(bits[..., 3]) + jnp.float32(1e-6)
-    prio = jnp.where(jnp.logical_and(accept, xor), prio, 0.0)
-    t_out = jnp.where(xor, l_out, B)
-    t_in = jnp.where(xor, l_in, B)
-    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, t_out].max(prio)
-    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, t_in].max(prio)
-    win = jnp.logical_and(
-        jnp.logical_or(t_out == B, prio == m_out[n_idx, t_out]),
-        jnp.logical_or(t_in == B, prio == m_in[n_idx, t_in]),
+    lead_own = s_own == 0
+    xor = lead_own != lead_other
+    hot = jnp.logical_and(prio > 0, xor)  # only leadership moves conflict
+    tok_out = jnp.where(hot, jnp.where(lead_own, b_own, b_other), B)
+    tok_in = jnp.where(hot, jnp.where(lead_own, b_other, b_own), B)
+    return ExchangeProposals(s=s_own, b_own=b_own, b_other=b_other,
+                             tok_out=tok_out, tok_in=tok_in, prio=prio)
+
+
+def exchange_thin_apply(m: ModelArrays, a, p: ExchangeProposals):
+    """Thin leadership-moving exchanges to one kept unit per broker per
+    direction (token B bypasses the maps — count-invariant swaps are
+    conflict-free by the one-pair-per-partition construction), then
+    apply: own slot <- incoming broker. Both halves of a pair share
+    prio/tokens, so they win or lose together."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    n_idx = jnp.arange(N)[:, None]
+    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, p.tok_out].max(
+        p.prio
     )
-    keep = jnp.logical_and(accept, win)
+    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, p.tok_in].max(
+        p.prio
+    )
+    keep = jnp.logical_and(
+        p.prio > 0,
+        jnp.logical_and(
+            jnp.logical_or(p.tok_out == B,
+                           p.prio == m_out[n_idx, p.tok_out]),
+            jnp.logical_or(p.tok_in == B,
+                           p.prio == m_in[n_idx, p.tok_in]),
+        ),
+    )
+    r_iota = jnp.arange(R)[None, None, :]
+    write = jnp.logical_and(keep[:, :, None], r_iota == p.s[:, :, None])
+    return jnp.where(write, p.b_other[:, :, None], a)
 
-    # apply: each partition is in exactly one pair, so the two scatters
-    # never collide; rejected pairs rewrite their current values
-    new_u = jnp.where(keep, b_v, b_u)
-    new_v = jnp.where(keep, b_u, b_v)
-    a = a.at[n_idx, u2, su].set(new_u)
-    a = a.at[n_idx, v2, sv].set(new_v)
-    return a
+
+def exchange_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp,
+                   halves=None):
+    """Cross-partition replica exchange — the count-invariant move.
+
+    Under exact-equality bands (lo == hi on broker/rack totals, common
+    when sizes divide evenly) single-site replaces always pass through a
+    penalized state and freeze out at every temperature (LAMBDA >> t_hi);
+    redistribution then needs swaps that leave every per-broker and
+    per-rack total untouched. Each pair proposes swapping one replica
+    slot; only leader-count and per-partition diversity penalties can
+    change, and both are evaluated exactly — half per side, combined
+    with one partner-aligned gather."""
+    N, P, _R = a.shape
+    if P < 2:
+        return a
+    prop = propose_exchange(m, a, key, temp, halves=halves)
+    return exchange_thin_apply(m, a, prop)
 
 
 def make_sweep_solver_fn(
@@ -501,7 +616,7 @@ def make_sweep_solver_fn(
     is a runtime argument so clock-checked chunked solves reuse one
     executable. ``scorer`` selects the bulk-rescoring implementation
     (``_make_scorer``); every scorer yields bit-identical trajectories."""
-    hists, scores, propose = _make_scorer(scorer)
+    hists, scores, propose, halves = _make_scorer(scorer)
 
     def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
               temps: jax.Array):
@@ -536,7 +651,8 @@ def make_sweep_solver_fn(
             key, sub = random.split(key)
             a = lax.cond(
                 do_exchange,
-                lambda a: exchange_sweep(m, a, sub, temp),
+                lambda a: exchange_sweep(m, a, sub, temp,
+                                         halves=halves),
                 lambda a: sweep_once(m, a, sub, temp, hists=hists,
                                      propose=propose),
                 a,

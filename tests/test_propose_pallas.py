@@ -105,3 +105,69 @@ def test_unequal_racks_and_rf1_partitions(rng):
     np.testing.assert_array_equal(
         np.asarray(thin_apply(m, a, px)), np.asarray(thin_apply(m, a, pp))
     )
+
+
+def test_exchange_halves_bit_identical(rng):
+    """The exchange-halves kernel reproduces the XLA reference exactly,
+    and the full exchange sweep is byte-equal between paths."""
+    from kafka_assignment_optimizer_tpu.ops.propose_pallas import (
+        exchange_halves_pallas,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        _exchange_halves_xla,
+        exchange_sweep,
+    )
+
+    inst, m = _instance(rng, nb=13, npart=37, rf=3, nr=3)
+    a = _chains(m, inst, rng, 5)
+    N, P, R = a.shape
+    lcnt = jnp.zeros((N, inst.num_brokers + 1), jnp.int32).at[
+        jnp.arange(N)[:, None], a[:, :, 0]
+    ].add(1)
+    s_own = jnp.asarray(
+        rng.integers(0, inst.max_rf, size=(N, P)) % np.maximum(
+            np.asarray(m.rf)[None, :], 1
+        ), jnp.int32)
+    lead_other = jnp.asarray(rng.integers(0, 2, size=(N, P)), bool)
+    b_other = jnp.asarray(
+        rng.integers(0, inst.num_brokers, size=(N, P)), jnp.int32)
+    hx = _exchange_halves_xla(m, a, lcnt, s_own, lead_other, b_other)
+    hp = exchange_halves_pallas(m, a, lcnt, s_own, lead_other, b_other,
+                                interpret=True)
+    for i, name in enumerate(("b_own", "dw", "ddiv", "dlcnt", "legal")):
+        np.testing.assert_array_equal(np.asarray(hx[i]),
+                                      np.asarray(hp[i]), err_msg=name)
+
+    # whole exchange sweeps, both paths, byte-equal populations
+    ax = ap = a
+    for i, temp in enumerate((2.0, 0.4, 0.02)):
+        k = jax.random.fold_in(jax.random.PRNGKey(4), i)
+        ax = jax.jit(lambda a, k: exchange_sweep(m, a, k, temp))(ax, k)
+        ap = jax.jit(lambda a, k: exchange_sweep(
+            m, a, k, temp,
+            halves=lambda *args, **kw: exchange_halves_pallas(
+                *args, **kw, interpret=True),
+        ))(ap, k)
+        np.testing.assert_array_equal(np.asarray(ax), np.asarray(ap),
+                                      err_msg=f"exchange sweep {i}")
+
+
+def test_exchange_preserves_counts(rng):
+    """The exchange move is count-invariant by construction: per-broker
+    and per-rack replica totals must be untouched by any number of
+    exchange sweeps (only leadership and diversity may change)."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        exchange_sweep,
+    )
+
+    inst, m = _instance(rng, nb=12, npart=50, rf=2, nr=2)
+    a = _chains(m, inst, rng, 4)
+    before = np.sort(np.asarray(a).reshape(4, -1), axis=1)
+    out = a
+    for i in range(6):
+        out = jax.jit(lambda a, k: exchange_sweep(m, a, k, 2.0))(
+            out, jax.random.PRNGKey(i)
+        )
+    after = np.sort(np.asarray(out).reshape(4, -1), axis=1)
+    np.testing.assert_array_equal(before, after)
+    assert (np.asarray(out) != np.asarray(a)).any()  # it did something
