@@ -125,20 +125,22 @@ class ProblemInstance:
     def decode(self, a: np.ndarray) -> Assignment:
         """Map a candidate ``A[P, R]`` of broker indices back to
         reassignment JSON (leader = slot 0 = ``replicas[0]``,
-        ``README.md:65-78``)."""
-        parts = []
-        for p in range(self.num_parts):
-            reps = [
-                int(self.broker_ids[a[p, s]])
-                for s in range(int(self.rf[p]))
-            ]
-            parts.append(
-                PartitionAssignment(
-                    topic=self.topics[int(self.topic_of_part[p])],
-                    partition=int(self.part_id[p]),
-                    replicas=reps,
-                )
+        ``README.md:65-78``). One vectorized id translation; the Python
+        loop only assembles the output objects (at 10k partitions the
+        per-element indexing version cost ~0.1 s of the warm solve)."""
+        valid = self.slot_valid
+        ids = self.broker_ids[np.where(valid, a, 0)].tolist()
+        rfs = self.rf.tolist()
+        topic_names = [self.topics[t] for t in self.topic_of_part.tolist()]
+        pids = self.part_id.tolist()
+        parts = [
+            PartitionAssignment(
+                topic=topic_names[p],
+                partition=pids[p],
+                replicas=ids[p][: rfs[p]],
             )
+            for p in range(self.num_parts)
+        ]
         return Assignment(partitions=parts)
 
     # -- feasibility / scoring (numpy reference; oracle for all backends) --

@@ -214,6 +214,25 @@ class _Repair:
             if not dst.any() or not self.relocate_one(src, dst):
                 break  # stuck; the annealer takes it from here
 
+    def _batch_swaps(self, ordered_ps: np.ndarray, s_best: np.ndarray,
+                     swap) -> int:
+        """Apply the leader swaps for ``ordered_ps`` (best first) whose
+        two brokers are untouched so far in this pass, so per-swap deltas
+        computed against pass-start counts stay exact. Returns the last
+        partition swapped (-1 if none, unreachable for a nonempty
+        order)."""
+        used = np.zeros(self.B + 1, dtype=bool)
+        last = -1
+        for p in ordered_ps.tolist():
+            bl = int(self.a[p, 0])
+            bf = int(self.a[p, int(s_best[p]) + 1])
+            if used[bl] or used[bf]:
+                continue
+            used[bl] = used[bf] = True
+            swap(p, int(s_best[p]) + 1)
+            last = p
+        return last
+
     def fix_leaders(self, max_repairs: int) -> None:
         inst, B = self.inst, self.B
 
@@ -238,6 +257,12 @@ class _Repair:
             foll_valid = (np.arange(1, self.R)[None, :] < self.rf[:, None]) & (
                 foll < B
             )
+            # batched descent: one swap per pass made the seed the jumbo
+            # bottleneck (6.8 s of 11 at 50k partitions — thousands of
+            # O(P*R) passes). Each pass now applies every gain>=2 swap
+            # whose two brokers are untouched so far in the pass, so the
+            # gains (computed against pass-start counts) stay exact and
+            # the sum(lcnt^2) potential still strictly drops per swap.
             for _ in range(max_repairs):
                 lead = self.a[:, 0]
                 safe_lead = np.where(lead < B, lead, 0)
@@ -248,10 +273,11 @@ class _Repair:
                 f_best = f_cnt[np.arange(self.P), s_best]
                 gain = l_of_lead - np.where(f_best < np.iinfo(np.int64).max,
                                             f_best, np.iinfo(np.int64).max)
-                p = int(np.argmax(gain))
-                if gain[p] < 2:
+                cand = np.flatnonzero(gain >= 2)
+                if cand.size == 0:
                     break
-                swap(p, int(s_best[p]) + 1)
+                cand = cand[np.argsort(-gain[cand], kind="stable")]
+                self._batch_swaps(cand, s_best, swap)
 
         # phase 2 — band-violation descent with bounded neutral chaining:
         # vectorized over partitions, pick the leader<->follower swap with
@@ -293,13 +319,26 @@ class _Repair:
                 np.iinfo(np.int64).max // 2,
             )
             gain = np.where(usable, lc - f_best, np.iinfo(np.int64).min // 2)
+            # batch every strictly-improving swap whose brokers are
+            # untouched this pass (deltas stay exact; same jumbo-scale
+            # reasoning as phase 1). Neutral chain moves remain one per
+            # pass — their whole point is re-evaluating after each hop.
+            improving = np.flatnonzero(dviol < 0)
+            if improving.size:
+                improving = improving[
+                    np.lexsort((-gain[improving], dviol[improving]))
+                ]
+                prev_p = self._batch_swaps(improving, s_best, swap)
+                stall = 0
+                continue
             order = np.lexsort((-gain, dviol))
             p = int(order[0])
             if dviol[p] >= 0 and p == prev_p and self.P > 1:
                 p = int(order[1])
-            if dviol[p] < 0:
-                stall = 0
-            elif dviol[p] == 0 and gain[p] >= 1 and stall < 4 * self.B:
+            if dviol[p] == 0 and gain[p] >= 1 and stall < 64:
+                # short neutral-chain budget: long chains are phase 3's
+                # job (exact BFS augmentation); a 4*B budget burned ~7 s
+                # of single-step O(P*R) passes at 50k partitions
                 stall += 1
             else:
                 break

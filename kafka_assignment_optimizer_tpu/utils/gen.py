@@ -20,6 +20,8 @@ steady-state clusters, which is exactly what a reassignment starts from.
 
 from __future__ import annotations
 
+import dataclasses
+
 from dataclasses import dataclass
 
 from ..models.cluster import (
@@ -242,12 +244,31 @@ def leader_only(
     )
 
 
+def jumbo(
+    n_brokers: int = 512, n_racks: int = 16,
+    n_topics: int = 250, parts_per_topic: int = 200, rf: int = 3,
+) -> Scenario:
+    """Beyond the north star: 512 brokers / 16 racks / 50k partitions
+    RF=3 decommission — 5x the headline's partition count (150k replica
+    slots). No BASELINE counterpart; exists to demonstrate the sweep
+    engine's scaling headroom past the size that motivated the rebuild
+    (per-sweep work is O(chains * partitions); sequential depth stays
+    flat)."""
+    sc = decommission(n_brokers=n_brokers, n_racks=n_racks,
+                      n_topics=n_topics, parts_per_topic=parts_per_topic,
+                      rf=rf)
+    return dataclasses.replace(
+        sc, name="jumbo", notes=f"512b/50k-part decommission; {sc.notes}"
+    )
+
+
 SCENARIOS = {
     "demo": demo,
     "scale_out": scale_out,
     "decommission": decommission,
     "rf_change": rf_change,
     "leader_only": leader_only,
+    "jumbo": jumbo,
 }
 
 # shrunk per-scenario kwargs for quick CPU smoke runs: the single source of
@@ -259,4 +280,5 @@ SMOKE_KWARGS = {
     "decommission": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
     "rf_change": dict(n_brokers=16, n_topics=4, parts_per_topic=25),
     "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+    "jumbo": dict(n_brokers=48, n_topics=10, parts_per_topic=40),
 }
