@@ -29,9 +29,10 @@ from .seed import greedy_seed
 
 
 # partition count at which the sweep-parallel engine takes over from the
-# per-move Metropolis chains: above this, sequential chain steps dominate
-# wall-clock (one move per step), while a sweep applies up to min(P, B)
-# moves per fused step
+# per-move Metropolis chains OFF-TPU: above this, sequential chain steps
+# dominate wall-clock (one move per step), while a sweep applies up to
+# min(P, B) moves per fused step. On TPU the sweep engine is the default
+# at every size (see _defaults).
 _SWEEP_THRESHOLD_PARTS = 512
 
 
@@ -47,8 +48,14 @@ def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
         raise ValueError(
             f"unknown tpu engine {engine!r}; expected 'chain' or 'sweep'"
         )
+    # TPU always prefers the sweep engine: measured on v5e (r2), even a
+    # 10-partition demo solves 10x faster warm through the Mosaic sweep
+    # kernels than through the chain engine's sequential Metropolis scan
+    # (0.34 s vs 3.6 s; compile 4 s vs 29 s), at equal quality. The
+    # chain engine remains the small-instance default off-TPU, where its
+    # O(RF) per-step work beats sweeping whole small populations.
     engine = engine or (
-        "sweep" if P >= _SWEEP_THRESHOLD_PARTS else "chain"
+        "sweep" if (on_tpu or P >= _SWEEP_THRESHOLD_PARTS) else "chain"
     )
     if engine == "sweep":
         # sweep engine: sequential depth is `rounds` sweeps, flat in P;

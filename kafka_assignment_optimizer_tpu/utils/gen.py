@@ -20,9 +20,8 @@ steady-state clusters, which is exactly what a reassignment starts from.
 
 from __future__ import annotations
 
-import dataclasses
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from ..models.cluster import (
     Assignment,
@@ -257,8 +256,10 @@ def jumbo(
     sc = decommission(n_brokers=n_brokers, n_racks=n_racks,
                       n_topics=n_topics, parts_per_topic=parts_per_topic,
                       rf=rf)
-    return dataclasses.replace(
-        sc, name="jumbo", notes=f"512b/50k-part decommission; {sc.notes}"
+    return replace(
+        sc, name="jumbo",
+        notes=f"{n_brokers}b/{n_topics * parts_per_topic}-part "
+              f"decommission; {sc.notes}",
     )
 
 
