@@ -1,0 +1,27 @@
+# Optimizer-as-a-service (the reference runs a hosted POST /submit
+# instance; /root/reference/README.md:187-195). CPU image — the JAX CPU
+# backend runs the identical solve path; on a TPU VM install the
+# matching jax[tpu] wheel instead.
+FROM python:3.12-slim
+
+# g++ for the self-building native backends (exact C++ B&B + the
+# bundled lp_solve-compatible CLI)
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY kafka_assignment_optimizer_tpu ./kafka_assignment_optimizer_tpu
+RUN pip install --no-cache-dir .[milp]
+
+# non-root; the compile cache and native build cache live under /tmp
+ENV KAO_JIT_CACHE=/tmp/kao-jit-cache \
+    XDG_CACHE_HOME=/tmp/cache
+USER nobody
+
+EXPOSE 8787
+# saturation shedding and the per-solve cap are on by default; tune via
+# --lock-wait-s / --max-solve-s
+ENTRYPOINT ["kafka-assignment-optimizer-serve", "--host", "0.0.0.0", \
+            "--port", "8787"]

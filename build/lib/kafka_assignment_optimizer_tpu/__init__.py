@@ -1,0 +1,31 @@
+"""kafka_assignment_optimizer_tpu — TPU-native Kafka partition-reassignment
+optimizer.
+
+A from-scratch rebuild of the capabilities of
+``killerwhile/kafka-assignment-optimizer`` (reference mounted read-only at
+``/root/reference``): replica placement as constrained combinatorial
+optimization, minimizing replica moves under rack-awareness, balance, and
+leader constraints (``/root/reference/README.md:106-185``).
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``models``  — L0/L1/L3: ingest, solver-neutral model, weights, bounds
+- ``solvers`` — L4/L5/L6: LP emitter + lp_solve/MILP oracles, native C++
+  branch-and-bound, and the flagship JAX/TPU annealing engine
+- ``ops``     — scoring ops (XLA + Pallas TPU kernels)
+- ``parallel``— device mesh, shard_map solve, ICI collectives
+- ``utils``   — reporting, RNG, checkpointing
+"""
+
+from .api import optimize, OptimizeResult  # noqa: F401
+from .models.cluster import (  # noqa: F401
+    Assignment,
+    MoveReport,
+    PartitionAssignment,
+    Topology,
+    move_diff,
+    parse_broker_list,
+)
+from .models.instance import ProblemInstance, build_instance  # noqa: F401
+
+__version__ = "0.1.0"

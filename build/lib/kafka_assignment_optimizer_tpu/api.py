@@ -1,0 +1,87 @@
+"""High-level entry point: ``optimize()`` — the reference's end-to-end
+``submit -> parse -> model -> solve -> decode -> diff -> emit`` call stack
+(``/root/reference/README.md:189-195``; SURVEY.md §3.1)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from .models.cluster import Assignment, MoveReport, Topology, move_diff
+from .models.instance import ProblemInstance, build_instance
+from .solvers.base import SolveResult, get_solver
+
+
+@dataclass
+class OptimizeResult:
+    """Everything a caller (CLI, HTTP service, tests) needs: the plan, the
+    move diff vs the current assignment (plan minimality,
+    ``README.md:83-91``), and solver telemetry (observability per
+    SURVEY.md §5)."""
+
+    assignment: Assignment
+    moves: MoveReport
+    solve: SolveResult
+    instance: ProblemInstance = field(repr=False, default=None)
+    wall_clock_s: float = 0.0
+
+    @property
+    def replica_moves(self) -> int:
+        return self.moves.replica_moves
+
+    def report(self) -> dict:
+        viol = self.instance.violations(self.solve.a)
+        return {
+            "solver": self.solve.solver,
+            "replica_moves": self.moves.replica_moves,
+            "leader_changes": self.moves.leader_changes,
+            "objective_weight": self.instance.preservation_weight(self.solve.a),
+            "objective_upper_bound": self.instance.max_weight(),
+            "violations": viol,
+            "feasible": all(v == 0 for v in viol.values()),
+            "proven_optimal": self.solve.optimal,
+            "solver_wall_clock_s": round(self.solve.wall_clock_s, 4),
+            "total_wall_clock_s": round(self.wall_clock_s, 4),
+            "brokers": self.instance.num_brokers,
+            "partitions": self.instance.num_parts,
+            "racks": self.instance.num_racks,
+            **{f"solver_{k}": v for k, v in self.solve.stats.items()
+               if isinstance(v, (int, float, str, bool))},
+        }
+
+
+def optimize(
+    current: Assignment | str | dict,
+    broker_list: Sequence[int],
+    topology: Topology | dict | None = None,
+    target_rf: int | dict | None = None,
+    solver: str = "auto",
+    **solver_kwargs,
+) -> OptimizeResult:
+    """Compute a minimal-move, constraint-satisfying reassignment plan.
+
+    Args mirror the reference's inputs (``README.md:27-48``): the current
+    assignment (JSON text, dict, or :class:`Assignment`), the target broker
+    list, the broker->rack topology, and optionally a new replication
+    factor (the reference's RF-change use case, ``README.md:8-10``).
+    """
+    t0 = time.perf_counter()
+    if isinstance(current, str):
+        current = Assignment.from_json(current)
+    elif isinstance(current, dict):
+        current = Assignment.from_dict(current)
+    if isinstance(topology, dict):
+        topology = Topology.from_dict(topology)
+
+    inst = build_instance(current, broker_list, topology, target_rf)
+    result = get_solver(solver)(inst, **solver_kwargs)
+    plan = inst.decode(result.a)
+    moves = move_diff(current, plan)
+    return OptimizeResult(
+        assignment=plan,
+        moves=moves,
+        solve=result,
+        instance=inst,
+        wall_clock_s=time.perf_counter() - t0,
+    )

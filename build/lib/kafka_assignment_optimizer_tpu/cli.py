@@ -1,0 +1,158 @@
+"""CLI — JSON in/out mirroring the Kafka tooling UX the reference slots
+into (``kafka-reassign-partitions`` style, ``/root/reference/README.md:35-48``).
+
+Usage:
+    python -m kafka_assignment_optimizer_tpu \
+        --input current.json --broker-list 0-18 --topology topology.json \
+        [--rf 3] [--solver auto|milp|lp_solve|native|tpu] [--report]
+
+Reads the current assignment (reassignment JSON) from ``--input`` or stdin,
+writes the optimized plan (same dialect, ``README.md:67-78``) to stdout,
+and an observability report (moves, violations, objective, wall-clock —
+SURVEY.md §5) to stderr with ``--report``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .api import optimize
+from .models.cluster import Assignment, Topology, parse_broker_list
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="kafka_assignment_optimizer_tpu",
+        description="Minimal-move, rack-aware Kafka partition reassignment "
+        "optimizer (TPU-native rebuild of kafka-assignment-optimizer).",
+    )
+    ap.add_argument("--input", "-i", help="current assignment JSON file (default: stdin)")
+    ap.add_argument("--output", "-o", help="write plan JSON here (default: stdout)")
+    ap.add_argument(
+        "--broker-list",
+        required=True,
+        help="target brokers, e.g. '0,1,2' or '0-18' (README.md:48)",
+    )
+    ap.add_argument(
+        "--topology",
+        help="broker->rack map: JSON file, inline JSON, or 'even-odd' "
+        "(the reference demo topology, README.md:27-29). Default: one rack.",
+    )
+    ap.add_argument("--rf", type=int, help="target replication factor (RF change)")
+    ap.add_argument(
+        "--solver",
+        default="auto",
+        help="auto | milp | lp_solve | native | tpu (BASELINE.json:5)",
+    )
+    ap.add_argument("--report", action="store_true", help="print solve report to stderr")
+    ap.add_argument("--indent", type=int, default=2, help="output JSON indent")
+    # TPU engine knobs (SURVEY.md §5 config system)
+    ap.add_argument("--seed", type=int, default=0, help="search RNG seed")
+    ap.add_argument("--batch", type=int, help="candidates per device (tpu solver)")
+    ap.add_argument("--sweeps", type=int, help="annealing outer iterations (tpu solver)")
+    ap.add_argument(
+        "--engine",
+        choices=["chain", "sweep"],
+        help="tpu solver inner engine: per-move Metropolis chains (small "
+        "instances) or sweep-parallel proposals (default above "
+        "512 partitions)",
+    )
+    ap.add_argument("--time-limit", type=float, help="solver time limit seconds")
+    ap.add_argument(
+        "--checkpoint",
+        metavar="PATH",
+        help="warm-start from / save the best plan to this .npz (tpu solver); "
+        "re-solves of the same instance never regress below it",
+    )
+    ap.add_argument(
+        "--profile-dir",
+        metavar="DIR",
+        help="write a jax.profiler trace of the solve loop here (tpu solver)",
+    )
+    ap.add_argument(
+        "--emit-lp",
+        metavar="PATH",
+        help="also write the lp_solve LP-format equation file (README.md:144-185)",
+    )
+    return ap
+
+
+def load_topology(spec: str | None, broker_ids: list[int]) -> Topology | None:
+    if spec is None:
+        return None
+    if spec == "even-odd":
+        return Topology.even_odd(broker_ids)
+    p = Path(spec)
+    if p.exists():
+        return Topology.from_json(p.read_text())
+    return Topology.from_json(spec)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from .utils.platform import pin_platform
+
+    pin_platform()
+    try:
+        return _run(build_parser().parse_args(argv))
+    except (ValueError, KeyError, FileNotFoundError, RuntimeError, OSError) as e:
+        msg = e.args[0] if e.args and isinstance(e.args[0], str) else e
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"error: invalid JSON input: {e}", file=sys.stderr)
+        return 2
+
+
+def _run(args: argparse.Namespace) -> int:
+    text = Path(args.input).read_text() if args.input else sys.stdin.read()
+    current = Assignment.from_json(text)
+    brokers = parse_broker_list(args.broker_list)
+    all_ids = sorted(set(brokers) | set(current.broker_ids()))
+    topology = load_topology(args.topology, all_ids)
+
+    kw: dict = {}
+    if args.seed is not None:
+        kw["seed"] = args.seed
+    if args.batch:
+        kw["batch"] = args.batch
+    if args.sweeps:
+        kw["sweeps"] = args.sweeps
+    if args.engine:
+        kw["engine"] = args.engine
+    if args.checkpoint:
+        kw["checkpoint"] = args.checkpoint
+    if args.profile_dir:
+        kw["profile_dir"] = args.profile_dir
+    if args.time_limit:
+        kw["time_limit_s"] = args.time_limit
+
+    res = optimize(
+        current,
+        brokers,
+        topology,
+        target_rf=args.rf,
+        solver=args.solver,
+        **kw,
+    )
+
+    if args.emit_lp:
+        from .solvers.lp import emit_lp
+
+        Path(args.emit_lp).write_text(emit_lp(res.instance))
+
+    out = res.assignment.to_json(indent=args.indent)
+    if args.output:
+        Path(args.output).write_text(out + "\n")
+    else:
+        print(out)
+    rep = res.report()
+    if args.report:
+        print(json.dumps(rep, indent=2, default=str), file=sys.stderr)
+    return 0 if rep["feasible"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,19 +104,16 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
         )
     except Exception as e:  # noqa: BLE001 - lowering failure IS the signal
         report["pallas_error"] = repr(e)[:500]
-        pallas_s = None
-    if pallas_s is not None:
-        report["pallas_s"] = round(pallas_s, 5)
-        report["pallas_candidates_per_s"] = round(n / pallas_s)
-        report["pallas_speedup_vs_xla"] = round(xla_s / pallas_s, 3)
+        return report
+    report["pallas_s"] = round(pallas_s, 5)
+    report["pallas_candidates_per_s"] = round(n / pallas_s)
+    report["pallas_speedup_vs_xla"] = round(xla_s / pallas_s, 3)
 
     # the proposal kernel (the sweep hot loop's propose->accept stage):
-    # time one sweep-shaped evaluation at engine-shaped batch size
-    # (8 chains, the production default) — kernel in the PRODUCTION
-    # configuration (Pallas hists, _make_scorer('pallas')) against the
-    # all-XLA reference path. Independent of the scoring-kernel result
-    # above: the kernels lower separately and each failure is evidence.
-    from ..solvers.tpu.sweep import _make_scorer, propose_site
+    # time one sweep-shaped evaluation, kernel vs the XLA reference, at
+    # engine-shaped batch size (8 chains — the production default)
+    from ..ops.propose_pallas import propose_site_pallas
+    from ..solvers.tpu.sweep import _histograms, propose_site
 
     nprop = 8
     ap = a[:nprop]
@@ -128,10 +125,9 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
     )
     report["propose_xla_s"] = round(xla_p, 5)
     try:
-        hists_p, _scores, propose_p, _halves = _make_scorer("pallas")
         pal_p = _timeit(
-            jax.jit(lambda a, b: propose_p(
-                m, a, b, 1.0, hists=hists_p
+            jax.jit(lambda a, b: propose_site_pallas(
+                m, a, b, 1.0, hists=_histograms
             ).prio.sum()),
             ap, bits,
         )

@@ -1,0 +1,546 @@
+"""Pallas TPU kernel: fused single-site proposal evaluation.
+
+The sweep engine's hot loop is one ``propose -> accept -> thin -> apply``
+pass over every (chain, partition) per sweep. The XLA formulation of the
+propose/accept stage (``solvers.tpu.sweep.propose_site``) is ~10 separate
+table gathers and one-hot reductions over ``[N, P]`` operands — each one
+a full HBM round-trip, and gathers lower poorly on TPU (measured r2:
+~2.5-4.5 ms per op at 8 chains x 10k partitions, ~25 ms per sweep
+all-in). This kernel fuses the entire stage into ONE pass: each
+(chain, partition-tile) grid cell loads its tile once into VMEM and does
+every lookup as a one-hot multiply-reduce in registers.
+
+Layout: partitions live in the LANE dimension and brokers in SUBLANES —
+tables are streamed as transposed ``[B+1, TP]`` tiles — so every
+per-proposal table lookup ``tab[b]`` is ``(onehot(b) * tab).sum(axis=0)``,
+a cross-sublane reduction, and the outputs land lane-major exactly as the
+``[N, P]`` proposal records downstream thinning consumes.
+
+Bit-parity contract: given the same ``bits [N, P, 8]`` and histograms,
+this kernel reproduces ``propose_site`` EXACTLY (same integer arithmetic,
+same float32 ops in the same order) — asserted bit-for-bit in
+tests/test_propose_pallas.py via interpret mode, so the CPU CI executes
+the very code path the TPU runs and either engine path yields identical
+trajectories.
+
+Reference scope note: the reference solves this model with host-side
+lp_solve (``/root/reference/README.md:135-137``); a device-resident
+proposal kernel has no upstream counterpart — it is the TPU-native hot
+path SURVEY.md §7 step 6 calls for.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..solvers.tpu.arrays import (
+    LAMBDA,
+    SCALE_W,
+    ModelArrays,
+    band_pen as _band,
+)
+from ..solvers.tpu.sweep import P_LSWAP, P_RESTORE, SiteProposals
+
+# partition-tile width (lanes): multiple of 128
+_TP = 256
+
+
+def _u01(bits):
+    """uint32 -> uniform float32 in [0, 1) — must match arrays.u01
+    bit-for-bit. Mosaic has no uint32->float32 cast, so hop through
+    int32: the shifted value fits in 24 bits, making the detour exact."""
+    return (bits >> jnp.uint32(8)).astype(jnp.int32).astype(
+        jnp.float32
+    ) * jnp.float32(1.0 / (1 << 24))
+
+
+def _rand_idx(u, hi, hi_f):
+    """floor(u * hi) clamped to hi-1 — mirrors sweep._rand_idx."""
+    return jnp.minimum((u * hi_f).astype(jnp.int32), hi - 1)
+
+
+def _propose_kernel(
+    # inputs ------------------------------------------------------------
+    a_ref,       # [1, R, TP] int32 candidate tile, partitions in lanes
+    a0_ref,      # [R, TP] int32 original assignment tile
+    rf_ref,      # [1, TP] int32
+    prh_ref,     # [1, TP] int32 per-partition rack-diversity cap
+    wl_ref,      # [B1, TP] int32 leader weights, transposed
+    wf_ref,      # [B1, TP] int32 follower weights, transposed
+    rackof_ref,  # [B1, 1] int32 broker -> rack index (null -> K)
+    rlo_ref,     # [K1, 1] int32
+    rhi_ref,     # [K1, 1] int32
+    lim_ref,     # [1, 4] int32 (broker_lo, broker_hi, leader_lo, leader_hi)
+    temp_ref,    # [1, 1] float32
+    bits_ref,    # [1, 8, TP] uint32
+    cnt_ref,     # [B1, N] int32 broker histograms, all chains (full block:
+                 # Mosaic forbids 1-lane column blocks; the kernel selects
+                 # this grid row's chain column with a one-hot over lanes)
+    lcnt_ref,    # [B1, N] int32
+    rcnt_ref,    # [K1, N] int32
+    # outputs ([1, 1, TP] blocks of [N, 1, P] arrays) -------------------
+    o_islsw_ref,
+    o_s_ref,
+    o_bnew_ref,
+    o_blead_ref,
+    o_bats_ref,
+    o_prio_ref,
+):
+    B1, TP = wl_ref.shape
+    K1 = rcnt_ref.shape[0]
+    R = a0_ref.shape[0]
+    B = B1 - 1
+    i32 = jnp.int32
+    f32 = jnp.float32
+
+    # this grid row's chain: select its histogram columns [.., 1]
+    n = pl.program_id(0)
+    NN = cnt_ref.shape[1]
+    sel = (jax.lax.broadcasted_iota(i32, (1, NN), 1) == n).astype(i32)
+    cnt_col = (cnt_ref[...] * sel).sum(1, keepdims=True)    # [B1, 1]
+    lcnt_col = (lcnt_ref[...] * sel).sum(1, keepdims=True)  # [B1, 1]
+    rcnt_col = (rcnt_ref[...] * sel).sum(1, keepdims=True)  # [K1, 1]
+
+    # every per-partition quantity is a [1, TP] ROW vector — Mosaic
+    # cannot lower several ops (e.g. bool truncation) on 1-D vectors
+    rf = rf_ref[...]
+    rf_f = rf.astype(f32)
+    bits = bits_ref[0]
+
+    # ---- proposal: slot + move type + incoming broker ----------------
+    u_slot = _u01(bits[0:1, :])
+    s_rep = _rand_idx(u_slot, rf, rf_f)
+    hi = jnp.maximum(rf - 1, 1)
+    s_lsw = 1 + _rand_idx(u_slot, hi, hi.astype(f32))
+    is_lsw = jnp.logical_and(_u01(bits[1:2, :]) < P_LSWAP, rf > 1)
+    s = jnp.where(is_lsw, s_lsw, s_rep)
+
+    a = a_ref[0]  # [R, TP]
+    b_lead = a[0:1, :]
+    b_at_s = jnp.zeros_like(b_lead)
+    b_orig = jnp.zeros_like(b_lead)
+    s_orig = _rand_idx(_u01(bits[3:4, :]), i32(R), f32(R))
+    for r in range(R):
+        b_at_s = jnp.where(s == r, a[r:r + 1, :], b_at_s)
+        b_orig = jnp.where(s_orig == r, a0_ref[r:r + 1, :], b_orig)
+    b_old = jnp.where(is_lsw, b_lead, b_at_s)
+
+    b_uni = _rand_idx(_u01(bits[2:3, :]), i32(B), f32(B))
+    b_new = jnp.where(
+        jnp.logical_and(_u01(bits[4:5, :]) < P_RESTORE, b_orig < B),
+        b_orig,
+        b_uni,
+    )
+
+    # ---- one-hot lookup machinery ------------------------------------
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+
+    def oh(b):  # [1, TP] -> [B1, TP]
+        return (b == iota_b).astype(i32)
+
+    def lut(tab_col, ohb):  # tab [B1, 1] x onehot -> [1, TP]
+        return (ohb * tab_col).sum(axis=0, keepdims=True)
+
+    oh_old = oh(b_old)
+    oh_new = oh(b_new)
+    oh_ats = oh(b_at_s)
+
+    # ---- deltas (replace: slot s <- b_new) ---------------------------
+    lead_slot = s == 0
+    wl_new = (oh_new * wl_ref[...]).sum(0, keepdims=True)
+    wf_new = (oh_new * wf_ref[...]).sum(0, keepdims=True)
+    wl_old = (oh_old * wl_ref[...]).sum(0, keepdims=True)
+    wf_old = (oh_old * wf_ref[...]).sum(0, keepdims=True)
+    dw_rep = jnp.where(lead_slot, wl_new - wl_old, wf_new - wf_old)
+
+    lim = lim_ref[...]
+    blo, bhi = lim[0, 0], lim[0, 1]
+    llo, lhi = lim[0, 2], lim[0, 3]
+    cnt_old = lut(cnt_col, oh_old)
+    cnt_new = lut(cnt_col, oh_new)
+    d_cnt = (
+        _band(cnt_old - 1, blo, bhi) - _band(cnt_old, blo, bhi)
+        + _band(cnt_new + 1, blo, bhi) - _band(cnt_new, blo, bhi)
+    )
+    lcnt_old = lut(lcnt_col, oh_old)
+    lcnt_new = lut(lcnt_col, oh_new)
+    d_lcnt_rep = jnp.where(
+        lead_slot,
+        _band(lcnt_old - 1, llo, lhi) - _band(lcnt_old, llo, lhi)
+        + _band(lcnt_new + 1, llo, lhi) - _band(lcnt_new, llo, lhi),
+        0,
+    )
+
+    r_old = lut(rackof_ref[...], oh_old)
+    r_new = lut(rackof_ref[...], oh_new)
+    iota_k = jax.lax.broadcasted_iota(i32, (K1, TP), 0)
+    ohk_old = (r_old == iota_k).astype(i32)
+    ohk_new = (r_new == iota_k).astype(i32)
+    rc_old = (ohk_old * rcnt_col).sum(0, keepdims=True)
+    rc_new = (ohk_new * rcnt_col).sum(0, keepdims=True)
+    rlo_old = (ohk_old * rlo_ref[...]).sum(0, keepdims=True)
+    rhi_old = (ohk_old * rhi_ref[...]).sum(0, keepdims=True)
+    rlo_new = (ohk_new * rlo_ref[...]).sum(0, keepdims=True)
+    rhi_new = (ohk_new * rhi_ref[...]).sum(0, keepdims=True)
+    d_rcnt = (
+        _band(rc_old - 1, rlo_old, rhi_old) - _band(rc_old, rlo_old, rhi_old)
+        + _band(rc_new + 1, rlo_new, rhi_new) - _band(rc_new, rlo_new, rhi_new)
+    )
+
+    # diversity + row-duplication legality, per live slot
+    c_old = jnp.zeros_like(r_old)
+    c_new = jnp.zeros_like(r_new)
+    # i32 accumulator, not bool: a bool-typed constant lowers through an
+    # i8 -> i1 truncation Mosaic does not support
+    in_row = jnp.zeros_like(r_old)
+    for r in range(R):
+        live = r < rf
+        flat_r = jnp.where(live, a[r:r + 1, :], B)
+        rack_r = lut(rackof_ref[...], oh(flat_r))
+        c_old = c_old + (rack_r == r_old).astype(i32)
+        c_new = c_new + (rack_r == r_new).astype(i32)
+        in_row = in_row + (flat_r == b_new).astype(i32)
+    cap = prh_ref[...]
+
+    def g(c):
+        return jnp.maximum(c - cap, 0)
+
+    d_div = g(c_old - 1) - g(c_old) + g(c_new + 1) - g(c_new)
+    cross_rack = r_old != r_new
+    dpen_rep = d_cnt + d_lcnt_rep + jnp.where(cross_rack, d_rcnt + d_div, 0)
+    legal_rep = in_row == 0
+
+    # ---- deltas (lswap: promote slot s to leader) --------------------
+    wl_ats = (oh_ats * wl_ref[...]).sum(0, keepdims=True)
+    wf_ats = (oh_ats * wf_ref[...]).sum(0, keepdims=True)
+    dw_lsw = wl_ats + wf_old - wl_old - wf_ats
+    lc_f = lut(lcnt_col, oh_ats)
+    dpen_lsw = (
+        _band(lcnt_old - 1, llo, lhi) - _band(lcnt_old, llo, lhi)
+        + _band(lc_f + 1, llo, lhi) - _band(lc_f, llo, lhi)
+    )
+
+    dw = jnp.where(is_lsw, dw_lsw, dw_rep)
+    dpen = jnp.where(is_lsw, dpen_lsw, dpen_rep)
+    # pure i1 logic, not a select of two bool vectors — a bool-typed
+    # select materializes i8 operands and Mosaic cannot truncate i8->i1
+    legal = jnp.logical_or(
+        jnp.logical_and(is_lsw, rf > 1),
+        jnp.logical_and(jnp.logical_not(is_lsw), legal_rep),
+    )
+    delta = (SCALE_W * dw - LAMBDA * dpen).astype(f32)
+
+    # ---- Metropolis accept + thinning priority -----------------------
+    temp = temp_ref[0, 0]
+    accept = jnp.logical_and(
+        legal,
+        jnp.logical_or(
+            delta >= 0,
+            _u01(bits[5:6, :]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+        ),
+    )
+    prio = jnp.where(accept, _u01(bits[6:7, :]) + f32(1e-6), 0.0)
+
+    o_islsw_ref[0] = is_lsw.astype(i32)
+    o_s_ref[0] = s
+    o_bnew_ref[0] = b_new
+    o_blead_ref[0] = b_lead
+    o_bats_ref[0] = b_at_s
+    o_prio_ref[0] = prio
+
+
+def _pad_lanes(x, tp, value):
+    """Pad the LAST axis up to a multiple of tp."""
+    pad = (-x.shape[-1]) % tp
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
+                  rackof, rlo, rhi, lim, *, interpret: bool):
+    N, P, R = a.shape
+    B1 = wl.shape[0]
+    K1 = rlo.shape[0]
+    tp = min(_TP, max(128, -(-P // 128) * 128))
+
+    aT = _pad_lanes(jnp.swapaxes(a, 1, 2), tp, B1 - 1)        # [N, R, Pp]
+    bitsT = _pad_lanes(jnp.swapaxes(bits, 1, 2), tp, 0)       # [N, 8, Pp]
+    a0T = _pad_lanes(jnp.swapaxes(a0, 0, 1), tp, B1 - 1)      # [R, Pp]
+    rf_p = _pad_lanes(rf[None, :], tp, 1)                     # [1, Pp]
+    prh_p = _pad_lanes(prh[None, :], tp, 1)                   # [1, Pp]
+    wlT = _pad_lanes(wl, tp, 0)                               # [B1, Pp]
+    wfT = _pad_lanes(wf, tp, 0)                               # [B1, Pp]
+    cntT = jnp.swapaxes(cnt, 0, 1)                            # [B1, N]
+    lcntT = jnp.swapaxes(lcnt, 0, 1)
+    rcntT = jnp.swapaxes(rcnt, 0, 1)                          # [K1, N]
+    temp_a = jnp.full((1, 1), temp, jnp.float32)
+
+    Pp = aT.shape[-1]
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+
+    outs = pl.pallas_call(
+        _propose_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((R, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((K1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((K1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 4), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 8, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            # full-array blocks: Mosaic forbids 1-lane column blocks, so
+            # every chain's histogram column rides along and the kernel
+            # one-hot-selects its own (N is small)
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((K1, N), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        # outputs are [N, 1, Pp] (squeezed after the call): Mosaic needs
+        # the block's sublane dim to divide 8 or equal the array's, and
+        # a (1, tp) block of an [N, Pp] array satisfies neither for N>1
+        out_specs=[
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm)
+            for _ in range(6)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(aT, a0T, rf_p, prh_p, wlT, wfT, rackof, rlo, rhi, lim, temp_a,
+      bitsT, cntT, lcntT, rcntT)
+    islsw, s, bnew, blead, bats, prio = (o[:, 0, :P] for o in outs)
+    return islsw, s, bnew, blead, bats, prio
+
+
+def propose_site_pallas(m: ModelArrays, a: jax.Array, bits: jax.Array,
+                        temp, hists, *, interpret: bool = False):
+    """Drop-in replacement for ``sweep.propose_site`` (same SiteProposals,
+    bit-identical records). ``hists`` supplies the sweep-start histograms
+    — the Pallas scorer on TPU, so the whole hot loop stays in Mosaic."""
+    _flat, _racks, cnt, lcnt, rcnt = hists(m, a)
+    lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(
+        jnp.int32
+    )[None]
+    islsw, s, bnew, blead, bats, prio = _propose_call(
+        a, bits, cnt, lcnt, rcnt, temp,
+        m.a0, m.rf, m.part_rack_hi.astype(jnp.int32),
+        jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
+        jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),
+        m.rack_of.astype(jnp.int32)[:, None],
+        m.rack_lo.astype(jnp.int32)[:, None],
+        m.rack_hi.astype(jnp.int32)[:, None],
+        lim,
+        interpret=interpret,
+    )
+    return SiteProposals(is_lsw=islsw.astype(bool), s=s, b_new=bnew,
+                         b_lead=blead, b_at_s=bats, prio=prio)
+
+
+# ---------------------------------------------------------------------------
+# exchange halves: the pair-exchange move's per-partition delta half
+# (``sweep._exchange_halves_xla`` reproduced bit-for-bit), same layout
+# discipline as the proposal kernel
+# ---------------------------------------------------------------------------
+
+
+def _exchange_kernel(
+    a_ref,       # [1, R, TP] int32 candidate tile, partitions in lanes
+    rf_ref,      # [1, TP] int32
+    prh_ref,     # [1, TP] int32
+    wl_ref,      # [B1, TP] int32 leader weights, transposed
+    wf_ref,      # [B1, TP] int32 follower weights, transposed
+    rackof_ref,  # [B1, 1] int32
+    lim_ref,     # [1, 4] int32
+    sown_ref,    # [1, TP] int32 own slot
+    lother_ref,  # [1, TP] int32 partner slot is the leader slot (0/1)
+    bother_ref,  # [1, TP] int32 incoming broker
+    lcnt_ref,    # [B1, N] int32 leader histograms, all chains
+    # outputs ([1, 1, TP] blocks)
+    o_bown_ref,
+    o_dw_ref,
+    o_ddiv_ref,
+    o_dlcnt_ref,
+    o_legal_ref,
+):
+    B1, TP = wl_ref.shape
+    R = a_ref.shape[1]
+    B = B1 - 1
+    i32 = jnp.int32
+
+    n = pl.program_id(0)
+    NN = lcnt_ref.shape[1]
+    sel = (jax.lax.broadcasted_iota(i32, (1, NN), 1) == n).astype(i32)
+    lcnt_col = (lcnt_ref[...] * sel).sum(1, keepdims=True)  # [B1, 1]
+
+    rf = rf_ref[...]
+    s_own = sown_ref[0]          # [1, TP] (blocks are [1, 1, TP])
+    lead_other = lother_ref[0] > 0
+    b_other = bother_ref[0]
+    a = a_ref[0]  # [R, TP]
+
+    b_own = jnp.zeros_like(b_other)
+    for r in range(R):
+        b_own = jnp.where(s_own == r, a[r:r + 1, :], b_own)
+
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+
+    def oh(b):
+        return (b == iota_b).astype(i32)
+
+    def lut(tab, ohb):
+        return (ohb * tab).sum(axis=0, keepdims=True)
+
+    oh_own = oh(b_own)
+    oh_oth = oh(b_other)
+
+    # objective half
+    lead_own = s_own == 0
+    dw_own = jnp.where(
+        lead_own,
+        lut(wl_ref[...], oh_oth) - lut(wl_ref[...], oh_own),
+        lut(wf_ref[...], oh_oth) - lut(wf_ref[...], oh_own),
+    )
+
+    # pair-level leader-count term
+    lim = lim_ref[...]
+    llo, lhi = lim[0, 2], lim[0, 3]
+    xor = lead_own != lead_other
+    l_out = jnp.where(lead_own, b_own, b_other)
+    l_in = jnp.where(lead_own, b_other, b_own)
+    lo_c = lut(lcnt_col, oh(l_out))
+    li_c = lut(lcnt_col, oh(l_in))
+    dlcnt = jnp.where(
+        xor,
+        _band(lo_c - 1, llo, lhi) - _band(lo_c, llo, lhi)
+        + _band(li_c + 1, llo, lhi) - _band(li_c, llo, lhi),
+        0,
+    )
+
+    # diversity half + row legality, from the own row
+    r_out = lut(rackof_ref[...], oh_own)
+    r_in = lut(rackof_ref[...], oh_oth)
+    c_out = jnp.zeros_like(r_out)
+    c_in = jnp.zeros_like(r_in)
+    in_row = jnp.zeros_like(r_out)
+    for r in range(R):
+        live = r < rf
+        flat_r = jnp.where(live, a[r:r + 1, :], B)
+        rack_r = lut(rackof_ref[...], oh(flat_r))
+        c_out = c_out + (rack_r == r_out).astype(i32)
+        c_in = c_in + (rack_r == r_in).astype(i32)
+        in_row = in_row + (flat_r == b_other).astype(i32)
+    cap = prh_ref[...]
+
+    def g(c):
+        return jnp.maximum(c - cap, 0)
+
+    ddiv = jnp.where(
+        r_out != r_in,
+        g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in),
+        0,
+    )
+
+    o_bown_ref[0] = b_own
+    o_dw_ref[0] = dw_own
+    o_ddiv_ref[0] = ddiv
+    o_dlcnt_ref[0] = dlcnt
+    o_legal_ref[0] = (in_row == 0).astype(i32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _exchange_call(a, lcnt, s_own, lead_other, b_other, rf, prh, wl, wf,
+                   rackof, lim, *, interpret: bool):
+    N, P, R = a.shape
+    B1 = wl.shape[0]
+    tp = min(_TP, max(128, -(-P // 128) * 128))
+
+    aT = _pad_lanes(jnp.swapaxes(a, 1, 2), tp, B1 - 1)
+    rf_p = _pad_lanes(rf[None, :], tp, 1)
+    prh_p = _pad_lanes(prh[None, :], tp, 1)
+    wlT = _pad_lanes(wl, tp, 0)
+    wfT = _pad_lanes(wf, tp, 0)
+    sown = _pad_lanes(s_own[:, None, :], tp, 0)      # [N, 1, Pp]
+    loth = _pad_lanes(lead_other[:, None, :], tp, 0)
+    both = _pad_lanes(b_other[:, None, :], tp, 0)
+    lcntT = jnp.swapaxes(lcnt, 0, 1)
+
+    Pp = aT.shape[-1]
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+
+    outs = pl.pallas_call(
+        _exchange_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 4), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm)
+            for _ in range(5)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(aT, rf_p, prh_p, wlT, wfT, rackof, lim, sown, loth, both, lcntT)
+    return tuple(o[:, 0, :P] for o in outs)
+
+
+def exchange_halves_pallas(m: ModelArrays, a, lcnt, s_own, lead_other,
+                           b_other, b_own=None, *,
+                           interpret: bool = False):
+    """Drop-in replacement for ``sweep._exchange_halves_xla`` —
+    bit-identical half-deltas, fused in VMEM. ``b_own`` is accepted for
+    interface parity and ignored: the kernel rebuilds it from the tile,
+    where the R-way select costs nothing."""
+    del b_own
+    lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(
+        jnp.int32
+    )[None]
+    b_own, dw, ddiv, dlcnt, legal = _exchange_call(
+        a, lcnt, s_own.astype(jnp.int32),
+        lead_other.astype(jnp.int32), b_other,
+        m.rf, m.part_rack_hi.astype(jnp.int32),
+        jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
+        jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),
+        m.rack_of.astype(jnp.int32)[:, None],
+        lim,
+        interpret=interpret,
+    )
+    return b_own, dw, ddiv, dlcnt, legal > 0

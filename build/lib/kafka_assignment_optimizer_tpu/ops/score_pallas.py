@@ -1,0 +1,220 @@
+"""Pallas TPU kernel: tiled batched candidate scoring.
+
+The reference's only compute engine is lp_solve's branch-and-bound on the
+host CPU (``/root/reference/README.md:135-137``). In the TPU build, bulk
+exact (re)scoring of candidate populations — seed pools, final
+verification, polish sweeps — is a first-class device op. This kernel
+scores ``A[N, P, R]`` candidates against the full model in one fused pass,
+tiled so arbitrarily many partitions stream through VMEM:
+
+- grid = (N, ceil(P / TP)): one candidate per row of the grid, partitions
+  in tiles of TP; histograms accumulate in the (revisited) output blocks.
+- everything is formulated as one-hot algebra, not scatter: broker
+  histograms are reductions of ``onehot(A_tile)``; rack histograms are a
+  single MXU matmul ``onehot @ rack_onehot``; the objective is an
+  elementwise product with the streamed weight tiles — scatter/gather-free,
+  which is exactly what the VPU/MXU want (SURVEY.md §7 hard part 3).
+- band penalties are computed once, on the last partition tile, from the
+  accumulated histograms.
+
+``ops.score.score_batch`` (pure XLA) is the correctness oracle and the
+non-TPU fallback; parity is asserted in tests/test_score_pallas.py via
+interpret mode on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..solvers.tpu.arrays import ModelArrays
+from .score import Score
+
+# partition-tile height: multiple of the int32 sublane (8); 256 keeps the
+# streamed weight tiles ~0.5 MB at 256 brokers
+_TP = 256
+
+
+def _score_kernel(
+    a_ref,        # [1, TP, R] int32 candidate tile
+    valid_ref,    # [TP, R] bool
+    wl_ref,       # [TP, B1] int32 leader-role weights
+    wf_ref,       # [TP, B1] int32 follower-role weights
+    rack1_ref,    # [B1, K1] float32 broker->rack one-hot
+    prh_ref,      # [TP, 1] int32 per-partition rack-diversity cap
+    rlo_ref,      # [1, K1] int32 per-rack lower bounds
+    rhi_ref,      # [1, K1] int32 per-rack upper bounds
+    lim_ref,      # [1, 4] int32 (broker_lo, broker_hi, leader_lo, leader_hi)
+    out_ref,      # [1, 1, 8] int32 (weight, pen_b, pen_l, pen_r, pen_pr, ...)
+    cnt_ref,      # [1, 1, B1] int32
+    lcnt_ref,     # [1, 1, B1] int32
+    rcnt_ref,     # [1, 1, K1] int32
+):
+    pt = pl.program_id(1)
+    last = pl.num_programs(1) - 1
+    B1 = cnt_ref.shape[2]
+    K1 = rcnt_ref.shape[2]
+    TP, R = valid_ref.shape
+    B = B1 - 1
+    K = K1 - 1
+
+    @pl.when(pt == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+        cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        lcnt_ref[...] = jnp.zeros_like(lcnt_ref)
+        rcnt_ref[...] = jnp.zeros_like(rcnt_ref)
+
+    a = a_ref[0]                      # [TP, R]
+    valid = valid_ref[...]
+    flat = jnp.where(valid, a, B)     # null out padded/invalid slots
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, 1, B1), 2)
+    oh = (flat[:, :, None] == iota_b).astype(jnp.int32)  # [TP, R, B1]
+
+    # broker histograms: replica+leader totals and leader totals
+    cnt_ref[0, 0, :] += oh.sum((0, 1))
+    lcnt_ref[0, 0, :] += oh[:, 0, :].sum(0)  # invalid slot 0 lands in null col
+
+    # rack algebra on the MXU: onehot(broker) @ onehot(rack-of-broker)
+    ohf = oh.reshape(TP * R, B1).astype(jnp.float32)
+    pr = jax.lax.dot(ohf, rack1_ref[...],
+                     preferred_element_type=jnp.float32)
+    pr = pr.reshape(TP, R, K1).sum(1).astype(jnp.int32)  # [TP, K1]
+    rcnt_ref[0, 0, :] += pr.sum(0)
+
+    # C10 per-(partition, rack) diversity overflow, real racks only
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, K1), 1)
+    over = jnp.maximum(pr - prh_ref[...], 0) * (iota_k < K)
+
+    # objective: leader weight on slot 0 + follower weights on slots 1..
+    # (null column of the weight tiles is 0, so no masking is needed)
+    w = (oh[:, 0, :] * wl_ref[...]).sum()
+    if R > 1:
+        w += (oh[:, 1:, :] * wf_ref[...][:, None, :]).sum()
+
+    # scalar stores to VMEM are not lowerable on TPU: compose the whole
+    # 8-wide accumulator row with iota masks and write it in one shot
+    iota8 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
+    out_ref[...] += jnp.where(iota8 == 0, w, 0) + jnp.where(
+        iota8 == 4, over.sum(), 0
+    )
+
+    @pl.when(pt == last)
+    def _bands():
+        real_b = jax.lax.broadcasted_iota(jnp.int32, (1, B1), 1) < B
+
+        def band(x, lo, hi):
+            v = jnp.maximum(x - hi, 0) + jnp.maximum(lo - x, 0)
+            return jnp.where(real_b, v, 0).sum()
+
+        lim = lim_ref[...]
+        pen_b = band(cnt_ref[0], lim[0, 0], lim[0, 1])
+        pen_l = band(lcnt_ref[0], lim[0, 2], lim[0, 3])
+        rv = (jnp.maximum(rcnt_ref[0] - rhi_ref[...], 0)
+              + jnp.maximum(rlo_ref[...] - rcnt_ref[0], 0))
+        pen_r = jnp.where(iota_k < K, rv, 0).sum()
+        out_ref[...] += (
+            jnp.where(iota8 == 1, pen_b, 0)
+            + jnp.where(iota8 == 2, pen_l, 0)
+            + jnp.where(iota8 == 3, pen_r, 0)
+        )
+
+
+def _pad_p(x, tp, value):
+    P = x.shape[0]
+    pad = (-P) % tp
+    if pad == 0:
+        return x
+    widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def score_batch_pallas(
+    a: jax.Array, m: ModelArrays, *, interpret: bool = False
+) -> Score:
+    """Score candidates ``a[N, P, R]`` on TPU via the Pallas kernel.
+
+    Drop-in replacement for ``ops.score.score_batch`` (same Score fields,
+    same integer semantics). ``interpret=True`` runs the kernel in the
+    Pallas interpreter — the CPU-CI path used by the parity tests.
+    """
+    N, P, R = a.shape
+    B1 = m.w_lead.shape[1]
+    K1 = m.rack_lo.shape[0]
+    B, K = B1 - 1, K1 - 1
+    tp = min(_TP, max(8, -(-P // 8) * 8))
+
+    a_p = _pad_p(jnp.swapaxes(a, 0, 1), tp, B).swapaxes(0, 1)
+    valid = _pad_p(m.slot_valid, tp, False)
+    wl = _pad_p(m.w_lead.astype(jnp.int32), tp, 0)
+    wf = _pad_p(m.w_foll.astype(jnp.int32), tp, 0)
+    prh = _pad_p(m.part_rack_hi.astype(jnp.int32)[:, None], tp, 0)
+    rack1 = (m.rack_of[:, None] == jnp.arange(K1)[None, :]).astype(jnp.float32)
+    lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(jnp.int32)[None]
+    rlo = m.rack_lo.astype(jnp.int32)[None]
+    rhi = m.rack_hi.astype(jnp.int32)[None]
+
+    Pp = valid.shape[0]
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+
+    out, cnt, lcnt, rcnt = pl.pallas_call(
+        _score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tp, R), lambda n, p: (n, p, 0), memory_space=vm),
+            pl.BlockSpec((tp, R), lambda n, p: (p, 0), memory_space=vm),
+            pl.BlockSpec((tp, B1), lambda n, p: (p, 0), memory_space=vm),
+            pl.BlockSpec((tp, B1), lambda n, p: (p, 0), memory_space=vm),
+            pl.BlockSpec((B1, K1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((tp, 1), lambda n, p: (p, 0), memory_space=vm),
+            pl.BlockSpec((1, K1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, K1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 4), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 8), lambda n, p: (n, 0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1, B1), lambda n, p: (n, 0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1, B1), lambda n, p: (n, 0, 0), memory_space=vm),
+            pl.BlockSpec((1, 1, K1), lambda n, p: (n, 0, 0), memory_space=vm),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1, 8), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, B1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, B1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1, K1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(a_p, valid, wl, wf, rack1, prh, rlo, rhi, lim)
+    out, cnt, lcnt, rcnt = out[:, 0], cnt[:, 0], lcnt[:, 0], rcnt[:, 0]
+
+    # padding rows land entirely in the null buckets; remove them so the
+    # histograms match the unpadded XLA scorer integer-for-integer
+    pad_rows = Pp - P
+    cnt = cnt.at[:, B].add(-pad_rows * R)
+    lcnt = lcnt.at[:, B].add(-pad_rows)
+    rcnt = rcnt.at[:, K].add(-pad_rows * R)
+    return Score(
+        weight=out[:, 0],
+        pen_broker=out[:, 1],
+        pen_leader=out[:, 2],
+        pen_rack=out[:, 3],
+        pen_part_rack=out[:, 4],
+        cnt=cnt,
+        lcnt=lcnt,
+        rcnt=rcnt,
+    )
+
+
+def score_batch_auto(a: jax.Array, m: ModelArrays) -> Score:
+    """Pallas kernel on TPU, pure-XLA scorer elsewhere."""
+    from .score import score_batch
+
+    if jax.devices()[0].platform == "tpu":
+        return score_batch_pallas(a, m)
+    return score_batch(a, m)

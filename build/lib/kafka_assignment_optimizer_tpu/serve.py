@@ -1,0 +1,308 @@
+"""HTTP service — optimizer-as-a-service (reference C16).
+
+The reference runs a hosted public instance with a ``POST /submit``
+endpoint (``/root/reference/README.md:187-195``); its payload schema is
+not documented in the mount, so this build defines its own (SURVEY.md §1
+L7). Stdlib-only (ThreadingHTTPServer) — no web-framework dependency.
+
+Endpoints:
+
+``POST /submit``
+    Request JSON::
+
+        {
+          "assignment": {"version": 1, "partitions": [...]},   # required
+          "brokers": "0-18" | [0, 1, ...],                     # required
+          "topology": {"0": "rackA", ...} | "even-odd" | null,
+          "rf": 3 | {"topic": 3} | null,
+          "solver": "auto" | "milp" | "native" | "tpu" | "lp_solve",
+          "options": {"seed": 0, "batch": 512, ...}            # solver kwargs
+        }
+
+    Response 200::
+
+        {"assignment": {...reassignment JSON...},              # the plan
+         "report": {...observability report (SURVEY.md §5)...}}
+
+    ``options`` accepts search knobs only (``ALLOWED_OPTIONS``);
+    path-valued solver kwargs are rejected. Every solve is capped at the
+    server's ``--max-solve-s`` budget.
+
+    Errors: 400 malformed JSON/schema or disallowed option (body
+    ``{"error": ...}``), 422 model rejected the inputs, 500 solver
+    failure, 503 solver saturated past ``--lock-wait-s``.
+
+``GET /healthz``
+    ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
+
+Run: ``python -m kafka_assignment_optimizer_tpu.serve --port 8787``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .api import optimize
+from .models.cluster import Assignment, Topology, parse_broker_list
+
+# one solve at a time: solver backends (XLA executables, the native lib)
+# are process-wide resources; concurrent HTTP readers stay responsive,
+# solves serialize
+_SOLVE_LOCK = threading.Lock()
+
+MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
+
+# Options the HTTP surface forwards to solvers: search-effort knobs only.
+# Path-valued solver kwargs (``checkpoint``, ``profile_dir``) are
+# deliberately NOT forwardable — a remote client must never be able to
+# make the service create directories or read/write files at
+# client-chosen paths. Operators who want checkpointing use the CLI.
+ALLOWED_OPTIONS = frozenset({
+    "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
+    "time_limit_s", "t_hi", "t_lo", "n_devices",
+})
+
+# saturation policy: how long a request waits for the solve lock before
+# the service sheds it with 503 (a single 10k-partition solve must not
+# make every later POST hang indefinitely), and the time limit injected
+# into each solve unless the client sets a smaller one
+DEFAULT_LOCK_WAIT_S = 30.0
+DEFAULT_MAX_SOLVE_S = 300.0
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _parse_brokers(spec) -> list[int]:
+    if isinstance(spec, str):
+        try:
+            return parse_broker_list(spec)
+        except ValueError as e:
+            raise ApiError(400, f"bad 'brokers' range string: {e}") from e
+    if isinstance(spec, list) and all(isinstance(b, int) for b in spec):
+        return spec
+    raise ApiError(400, "'brokers' must be a list of ints or a range string")
+
+
+def _parse_topology(spec, broker_ids: list[int]) -> Topology | None:
+    if spec is None:
+        return None
+    if spec == "even-odd":
+        return Topology.even_odd(broker_ids)
+    if isinstance(spec, dict):
+        return Topology.from_dict(spec)
+    raise ApiError(400, "'topology' must be a broker->rack object, 'even-odd', or null")
+
+
+def handle_submit(
+    payload: dict,
+    *,
+    lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+    max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+) -> dict:
+    """Pure request handler (also the unit-test surface): payload dict in,
+    response dict out; raises ApiError with an HTTP status on bad input,
+    and 503 when the solver is saturated past ``lock_wait_s``."""
+    if not isinstance(payload, dict):
+        raise ApiError(400, "request body must be a JSON object")
+    if "assignment" not in payload:
+        raise ApiError(400, "missing required field 'assignment'")
+    if "brokers" not in payload:
+        raise ApiError(400, "missing required field 'brokers'")
+    try:
+        current = Assignment.from_dict(payload["assignment"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ApiError(400, f"bad 'assignment': {e}") from e
+    brokers = _parse_brokers(payload["brokers"])
+    all_ids = sorted(set(brokers) | set(current.broker_ids()))
+    topology = _parse_topology(payload.get("topology"), all_ids)
+    rf = payload.get("rf")
+    if rf is not None and not isinstance(rf, (int, dict)):
+        raise ApiError(400, "'rf' must be an int, a topic->int object, or null")
+    solver = payload.get("solver", "auto")
+    if not isinstance(solver, str):
+        raise ApiError(400, "'solver' must be a string")
+    from .solvers.base import available_solvers
+
+    if solver != "auto" and solver not in available_solvers():
+        raise ApiError(
+            400,
+            f"unknown solver {solver!r}; available: "
+            f"{['auto', *available_solvers()]}",
+        )
+    options = payload.get("options") or {}
+    if not isinstance(options, dict):
+        raise ApiError(400, "'options' must be an object")
+    rejected = sorted(set(options) - ALLOWED_OPTIONS)
+    if rejected:
+        raise ApiError(
+            400,
+            f"unsupported option(s) {rejected}; allowed: "
+            f"{sorted(ALLOWED_OPTIONS)}",
+        )
+    options = dict(options)  # never mutate the caller's payload
+    limit = options.get("time_limit_s")
+    if limit is not None and (
+        isinstance(limit, bool) or not isinstance(limit, (int, float))
+        or not limit > 0
+    ):
+        raise ApiError(400, "'time_limit_s' must be a positive number")
+    if max_solve_s is not None:
+        # cap every solve: client may tighten the limit but not exceed it
+        options["time_limit_s"] = (
+            max_solve_s if limit is None else min(float(limit), max_solve_s)
+        )
+
+    if not _SOLVE_LOCK.acquire(timeout=lock_wait_s):
+        raise ApiError(
+            503,
+            f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
+        )
+    try:
+        res = optimize(
+            current, brokers, topology, target_rf=rf, solver=solver,
+            **options,
+        )
+    except (ValueError, KeyError) as e:
+        msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
+        raise ApiError(422, f"model rejected inputs: {msg}") from e
+    except TypeError as e:
+        raise ApiError(400, f"bad solver options: {e}") from e
+    except RuntimeError as e:
+        raise ApiError(500, f"solver failed: {e}") from e
+    finally:
+        _SOLVE_LOCK.release()
+    return {
+        "assignment": res.assignment.to_dict(),
+        "report": res.report(),
+    }
+
+
+def handle_healthz() -> dict:
+    import jax
+
+    from .solvers.base import available_solvers
+
+    return {
+        "status": "ok",
+        "solvers": available_solvers(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+class Handler(BaseHTTPRequestHandler):
+    server_version = "kafka-assignment-optimizer-tpu/1.0"
+
+    def _send(self, status: int, obj: dict) -> None:
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route access logs to stderr quietly
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _route(self) -> str:
+        # drop any query string (LB health probes append them) and a
+        # trailing slash before matching
+        path = self.path.split("?", 1)[0]
+        return path.rstrip("/") or "/"
+
+    def do_GET(self):
+        if self._route() in ("/", "/healthz"):
+            self._send(200, handle_healthz())
+        else:
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def do_POST(self):
+        if self._route() != "/submit":
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+            return
+        try:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError as e:
+                raise ApiError(400, f"bad Content-Length header: {e}") from e
+            if n > MAX_BODY_BYTES:
+                raise ApiError(413, "request body too large")
+            raw = self.rfile.read(n)
+            try:
+                payload = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ApiError(400, f"invalid JSON: {e}") from e
+            self._send(200, handle_submit(
+                payload,
+                lock_wait_s=getattr(self.server, "lock_wait_s",
+                                    DEFAULT_LOCK_WAIT_S),
+                max_solve_s=getattr(self.server, "max_solve_s",
+                                    DEFAULT_MAX_SOLVE_S),
+            ))
+        except ApiError as e:
+            self._send(e.status, {"error": str(e)})
+        except Exception as e:  # never leak a traceback as a hung socket
+            self._send(500, {"error": f"internal error: {e}"})
+
+
+def make_server(host: str = "127.0.0.1", port: int = 8787,
+                verbose: bool = False,
+                lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+                max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+                ) -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.verbose = verbose
+    srv.lock_wait_s = lock_wait_s
+    srv.max_solve_s = max_solve_s
+    return srv
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kafka_assignment_optimizer_tpu.serve",
+        description="Kafka reassignment optimizer HTTP service (POST /submit)",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8787)
+    ap.add_argument("--verbose", action="store_true", help="access logs")
+    ap.add_argument("--lock-wait-s", type=float,
+                    default=DEFAULT_LOCK_WAIT_S,
+                    help="max seconds a request waits for the solver "
+                         "before 503 (saturation shedding)")
+    ap.add_argument("--max-solve-s", type=float,
+                    default=DEFAULT_MAX_SOLVE_S,
+                    help="time limit injected into every solve; clients "
+                         "may tighten but not exceed it (0 = uncapped)")
+    args = ap.parse_args(argv)
+    if args.lock_wait_s < 0:
+        ap.error("--lock-wait-s must be >= 0")
+    if args.max_solve_s < 0:
+        ap.error("--max-solve-s must be >= 0 (0 = uncapped)")
+    from .utils.platform import pin_platform
+
+    pin_platform()
+    srv = make_server(
+        args.host, args.port, verbose=args.verbose,
+        lock_wait_s=args.lock_wait_s,
+        max_solve_s=args.max_solve_s or None,
+    )
+    print(f"listening on http://{args.host}:{srv.server_address[1]}", file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

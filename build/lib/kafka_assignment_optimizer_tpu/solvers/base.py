@@ -1,0 +1,105 @@
+"""Solver backend registry.
+
+The reference has exactly one backend — the external native lp_solve MILP
+solver (``/root/reference/README.md:135-137``). This build keeps that
+*role* as the reference path and adds alternatives behind one interface
+(``--solver=...`` per BASELINE.json:5):
+
+- ``milp``     exact 0-1 ILP via scipy/HiGHS (native C++, in-process)
+- ``lp_solve`` the reference's solver via subprocess, when installed
+- ``native``   bundled C++ branch-and-bound (exact, specialized)
+- ``tpu``      JAX/Pallas vmapped annealing engine (the deliverable)
+- ``auto``     exact solver for small instances, ``tpu`` at scale
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+import numpy as np
+
+from ..models.instance import ProblemInstance
+
+
+@dataclass
+class SolveResult:
+    """A solved candidate in broker-index space plus solver telemetry."""
+
+    a: np.ndarray  # [P, R] int32 broker indices, slot 0 = leader
+    solver: str
+    wall_clock_s: float = 0.0
+    objective: int | None = None  # preservation weight achieved
+    optimal: bool = False  # proven optimal (exact backends)
+    stats: dict = field(default_factory=dict)
+
+
+class Solver(Protocol):
+    def __call__(self, inst: ProblemInstance, **kwargs) -> SolveResult: ...
+
+
+_REGISTRY: dict[str, Callable[..., SolveResult]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def available_solvers() -> list[str]:
+    _load_all()
+    return sorted(_REGISTRY)
+
+
+def get_solver(name: str) -> Callable[..., SolveResult]:
+    _load_all()
+    if name == "auto":
+        return _auto_solve
+    if name not in _REGISTRY:
+        detail = ""
+        if name in _LOAD_ERRORS:
+            detail = f"; backend failed to import:\n{_LOAD_ERRORS[name]}"
+        raise KeyError(
+            f"unknown solver {name!r}; available: {available_solvers()}{detail}"
+        )
+    return _REGISTRY[name]
+
+
+_LOAD_ERRORS: dict[str, str] = {}
+
+
+def _load_all() -> None:
+    # import for registration side effects; optional backends degrade softly
+    # but record *why* they are unavailable so errors stay diagnosable
+    import importlib
+    import traceback
+
+    from . import milp  # noqa: F401
+
+    for name, mod in [
+        ("lp_solve", ".lp"),
+        ("native", ".native"),
+        ("tpu", ".tpu.engine"),
+    ]:
+        if name in _REGISTRY or name in _LOAD_ERRORS:
+            continue
+        try:
+            importlib.import_module(mod, package=__package__)
+        except Exception:
+            _LOAD_ERRORS[name] = traceback.format_exc(limit=3)
+
+
+def _auto_solve(inst: ProblemInstance, **kw) -> SolveResult:
+    """Exact ILP when the variable space is small enough to be instant;
+    the TPU engine otherwise."""
+    _load_all()
+    nvars = 2 * inst.num_brokers * inst.num_parts
+    if nvars <= 20_000 or "tpu" not in _REGISTRY:
+        return _REGISTRY["milp"](inst, **kw)
+    return _REGISTRY["tpu"](inst, **kw)
+
+

@@ -1,0 +1,416 @@
+"""Vmapped simulated-annealing kernel — the hot loop of the TPU backend.
+
+Design (SURVEY.md §3.4, §7): a population of N candidate assignments
+``A[N, P, R]`` lives in HBM; each candidate runs an independent Metropolis
+chain. Every step proposes one of three constraint-aware move types and
+evaluates it in **O(RF)** time via incremental count/penalty deltas — no
+full rescoring in the loop:
+
+- ``replace``   A[p, s] <- b_new: changes broker/rack/leader counts; the
+  move that redistributes load (needs band slack to be accepted cold).
+- ``lswap``     swap A[p, 0] <-> A[p, s]: leadership only, zero replica
+  moves — the BASELINE.json leader-only-rebalance scenario's workhorse.
+- ``xswap``     swap A[p1, s1] <-> A[p2, s2] across partitions: per-broker
+  and per-rack totals are *invariant*, so it explores under tight (even
+  exact-equality) bands where ``replace`` would always be rejected.
+
+Everything is static-shape, branchless (where-selects), int32 state with
+an int64 selection key, inside ``lax.scan`` (steps) nested in ``lax.scan``
+(rounds) under one jit. ``vmap`` runs the N chains in lockstep on the VPU;
+the candidate axis is what ``shard_map`` shards across the mesh
+(``parallel.mesh``). Feasible-best snapshots are taken once per round —
+a [N, P, R] select, amortized to nothing — so late high-temperature
+wandering can never lose the best feasible plan found.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+
+from .arrays import (
+    LAMBDA,
+    SCALE_W,
+    ModelArrays,
+    band_pen as _shared_band_pen,
+    u01 as _shared_u01,
+)
+
+# move-type proposal mix
+P_REPLACE = 0.45
+P_LSWAP = 0.10  # remainder goes to xswap
+# within `replace`: probability of proposing the partition's ORIGINAL
+# broker for the slot (a restore) instead of a uniform one — the move that
+# claws preservation weight back after high-temperature wandering and
+# walks seeds toward the move-count optimum
+P_RESTORE = 0.5
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ChainState:
+    """Per-candidate annealing state (leading axis N under vmap)."""
+
+    a: jax.Array  # [P, R] int32
+    cnt: jax.Array  # [B+1] int32 replica+leader per broker
+    lcnt: jax.Array  # [B+1] int32 leaders per broker
+    rcnt: jax.Array  # [K+1] int32 replicas per rack
+    pen: jax.Array  # [] int32 total band+diversity violations
+    w: jax.Array  # [] int32 preservation weight
+    key: jax.Array  # [2] uint32
+
+
+def chain_score(st: ChainState) -> jax.Array:
+    return SCALE_W * st.w - LAMBDA * st.pen
+
+
+def best_key(st: ChainState) -> jax.Array:
+    """int32 ranking: any feasible candidate (weight >= 0) beats any
+    infeasible one (strictly negative, ranked by penalty). Weight is
+    bounded by ~5 * num_partitions, far inside int32."""
+    return jnp.where(st.pen == 0, st.w, -st.pen - 1)
+
+
+def init_chain(m: ModelArrays, a_seed: jax.Array, key: jax.Array) -> ChainState:
+    """Full scoring of the seed — the only non-incremental evaluation."""
+    from ...ops.score import score_one
+
+    s = score_one(a_seed, m)
+    return ChainState(
+        a=a_seed.astype(jnp.int32),
+        cnt=s.cnt,
+        lcnt=s.lcnt,
+        rcnt=s.rcnt,
+        pen=s.penalty,
+        w=s.weight,
+        key=key,
+    )
+
+
+_band_pen = _shared_band_pen
+
+
+def _delta_band(c_from, c_to, lo, hi):
+    """Penalty delta of moving one unit from bucket value c_from to c_to."""
+    return (
+        _band_pen(c_from - 1, lo, hi)
+        - _band_pen(c_from, lo, hi)
+        + _band_pen(c_to + 1, lo, hi)
+        - _band_pen(c_to, lo, hi)
+    )
+
+
+_u01 = _shared_u01
+
+
+def _anneal_step(
+    m: ModelArrays, st: ChainState, temp: jax.Array, row: jax.Array
+) -> ChainState:
+    """One Metropolis step for one chain. O(RF) work, all where-selects.
+
+    ``row`` is a [8] uint32 vector of presampled random bits (one
+    ``random.bits`` call per ROUND generates all of them — keeping threefry
+    key-splitting out of the hot loop is worth ~10x on step latency).
+    Modulo bias from ``bits % n`` is negligible for n << 2^32.
+    """
+    P, R = m.a0.shape
+    B, K = m.num_brokers, m.num_racks
+    i32 = jnp.int32
+    u32 = jnp.uint32
+
+    p = (row[0] % u32(P)).astype(i32)
+    rfp = m.rf[p]
+    u_type = _u01(row[1])
+    is_rep = u_type < P_REPLACE
+    is_lsw = jnp.logical_and(u_type >= P_REPLACE, u_type < P_REPLACE + P_LSWAP)
+    is_xsw = jnp.logical_not(jnp.logical_or(is_rep, is_lsw))
+
+    s_raw = (row[2] & u32(0x3FFFFFFF)).astype(i32)
+    s_rep = s_raw % rfp
+    s_lsw = 1 + s_raw % jnp.maximum(rfp - 1, 1)
+    s1 = jnp.where(is_lsw, s_lsw, s_rep)
+
+    row1 = st.a[p]  # [R]
+    valid1 = m.slot_valid[p]
+    b_old = row1[s1]
+    # replace proposal: restore the slot's original broker with prob
+    # P_RESTORE (when it exists and is eligible), else uniform
+    b_uni = (row[3] % u32(B)).astype(i32)
+    s_orig = ((row[7] & u32(0xFFFF)) % u32(R)).astype(i32)
+    b_orig = m.a0[p, s_orig]
+    b_new_rep = jnp.where(
+        jnp.logical_and(_u01(row[7]) < P_RESTORE, b_orig < B), b_orig, b_uni
+    )
+
+    # second site for xswap
+    p2 = (row[4] % u32(P)).astype(i32)
+    rfp2 = m.rf[p2]
+    s2 = (row[5] & u32(0x3FFFFFFF)).astype(i32) % rfp2
+    row2 = st.a[p2]
+    valid2 = m.slot_valid[p2]
+    b2 = row2[s2]
+
+    # the broker arriving at (p, s1): replace -> b_new_rep; lswap -> the
+    # follower being promoted; xswap -> b2
+    b_in = jnp.where(is_rep, b_new_rep, jnp.where(is_lsw, row1[s_lsw], b2))
+
+    # --- validity -----------------------------------------------------
+    in_p1 = jnp.logical_and(row1 == b_in, valid1).any()
+    in_p2 = jnp.logical_and(row2 == b_old, valid2).any()
+    valid_rep = jnp.logical_not(in_p1)
+    valid_lsw = rfp >= 2
+    valid_xsw = jnp.logical_and(
+        jnp.logical_not(in_p1),
+        jnp.logical_and(jnp.logical_not(in_p2), p != p2),
+    )
+    valid = jnp.where(is_rep, valid_rep, jnp.where(is_lsw, valid_lsw, valid_xsw))
+
+    # --- weight delta -------------------------------------------------
+    wl, wf = m.w_lead, m.w_foll
+    lead1 = s1 == 0
+    lead2 = s2 == 0
+    # role-aware weight of broker b at (partition, slot)
+    dw_rep = jnp.where(
+        lead1, wl[p, b_in] - wl[p, b_old], wf[p, b_in] - wf[p, b_old]
+    )
+    bl, bf = row1[0], row1[s_lsw]
+    dw_lsw = (wl[p, bf] + wf[p, bl]) - (wl[p, bl] + wf[p, bf])
+    dw_xsw = (
+        jnp.where(lead1, wl[p, b2] - wl[p, b_old], wf[p, b2] - wf[p, b_old])
+        + jnp.where(lead2, wl[p2, b_old] - wl[p2, b2], wf[p2, b_old] - wf[p2, b2])
+    )
+    dw = jnp.where(is_rep, dw_rep, jnp.where(is_lsw, dw_lsw, dw_xsw)).astype(i32)
+
+    # --- penalty deltas ----------------------------------------------
+    def f_cnt(b_from, b_to, counts, lo, hi):
+        both_real = jnp.logical_and(b_from < B, b_to < B)
+        d = _delta_band(counts[b_from], counts[b_to], lo, hi)
+        return jnp.where(jnp.logical_and(both_real, b_from != b_to), d, 0)
+
+    # replace: broker totals, leader totals (if leader slot), rack totals
+    d_cnt = f_cnt(b_old, b_in, st.cnt, m.broker_band[0], m.broker_band[1])
+    d_lead_rep = jnp.where(
+        lead1,
+        f_cnt(b_old, b_in, st.lcnt, m.leader_band[0], m.leader_band[1]),
+        0,
+    )
+    r_old, r_in = m.rack_of[b_old], m.rack_of[b_in]
+    d_rack = jnp.where(
+        r_old != r_in,
+        _band_pen(st.rcnt[r_old] - 1, m.rack_lo[r_old], m.rack_hi[r_old])
+        - _band_pen(st.rcnt[r_old], m.rack_lo[r_old], m.rack_hi[r_old])
+        + _band_pen(st.rcnt[r_in] + 1, m.rack_lo[r_in], m.rack_hi[r_in])
+        - _band_pen(st.rcnt[r_in], m.rack_lo[r_in], m.rack_hi[r_in]),
+        0,
+    )
+
+    # partition-rack diversity deltas: local recount over R slots
+    racks1 = jnp.where(valid1, m.rack_of[row1], K)
+
+    def div_delta(racks_row, cap, r_from, r_to):
+        c_from = (racks_row == r_from).sum()
+        c_to = (racks_row == r_to).sum()
+        g = lambda c: jnp.maximum(c - cap, 0)
+        return jnp.where(
+            r_from != r_to,
+            g(c_from - 1) - g(c_from) + g(c_to + 1) - g(c_to),
+            0,
+        )
+
+    d_div1 = div_delta(racks1, m.part_rack_hi[p], r_old, r_in)
+    racks2 = jnp.where(valid2, m.rack_of[row2], K)
+    r_b2 = m.rack_of[b2]
+    d_div2 = div_delta(racks2, m.part_rack_hi[p2], r_b2, r_old)
+
+    # lswap: only leader totals move between the two brokers
+    d_lead_lsw = f_cnt(bl, bf, st.lcnt, m.leader_band[0], m.leader_band[1])
+
+    # xswap: cnt/rcnt invariant; lcnt moves only when exactly one of the
+    # two slots is a leader slot (both-leader swaps permute leadership,
+    # leaving the histogram unchanged)
+    lead_xor = jnp.logical_xor(lead1, lead2)
+    lsub = jnp.where(lead_xor, jnp.where(lead1, b_old, b2), B)
+    ladd = jnp.where(lead_xor, jnp.where(lead1, b2, b_old), B)
+    d_lead_xsw = f_cnt(lsub, ladd, st.lcnt, m.leader_band[0], m.leader_band[1])
+
+    dpen_rep = d_cnt + d_lead_rep + d_rack + d_div1
+    dpen_lsw = d_lead_lsw
+    dpen_xsw = d_div1 + d_div2 + d_lead_xsw
+    dpen = jnp.where(
+        is_rep, dpen_rep, jnp.where(is_lsw, dpen_lsw, dpen_xsw)
+    ).astype(i32)
+
+    # --- accept -------------------------------------------------------
+    delta = (SCALE_W * dw - LAMBDA * dpen).astype(jnp.float32)
+    accept = jnp.logical_and(
+        valid,
+        jnp.logical_or(
+            delta >= 0,
+            _u01(row[6]) < jnp.exp(delta / jnp.maximum(temp, 1e-6)),
+        ),
+    )
+
+    # --- apply (single-element writes; rejected moves write back) -----
+    acc_i = accept.astype(i32)
+    # site writes: (p, i1) <- v1 ; (pw2, i2) <- v2
+    i1 = jnp.where(is_lsw, 0, s1)
+    v1 = jnp.where(is_lsw, bf, b_in)
+    pw2 = jnp.where(is_xsw, p2, p)
+    i2 = jnp.where(is_lsw, s_lsw, jnp.where(is_xsw, s2, s1))
+    v2 = jnp.where(is_lsw, bl, jnp.where(is_xsw, b_old, b_in))
+    a = st.a
+    a = a.at[p, i1].set(jnp.where(accept, v1, a[p, i1]))
+    a = a.at[pw2, i2].set(jnp.where(accept, v2, a[pw2, i2]))
+
+    # count updates (replace only for cnt/rcnt)
+    upd_c = acc_i * is_rep.astype(i32)
+    cnt = st.cnt.at[b_old].add(-upd_c).at[b_in].add(upd_c)
+    rcnt = st.rcnt.at[r_old].add(-upd_c).at[r_in].add(upd_c)
+    # leader count updates, unified across move types
+    l_from = jnp.where(
+        is_rep,
+        jnp.where(lead1, b_old, B),
+        jnp.where(is_lsw, bl, lsub),
+    )
+    l_to = jnp.where(
+        is_rep,
+        jnp.where(lead1, b_in, B),
+        jnp.where(is_lsw, bf, ladd),
+    )
+    upd_l = acc_i * jnp.logical_and(l_from < B, l_to < B).astype(i32)
+    lcnt = st.lcnt.at[l_from].add(-upd_l).at[l_to].add(upd_l)
+
+    return ChainState(
+        a=a,
+        cnt=cnt,
+        lcnt=lcnt,
+        rcnt=rcnt,
+        pen=st.pen + jnp.where(accept, dpen, 0),
+        w=st.w + jnp.where(accept, dw, 0),
+        key=st.key,
+    )
+
+
+def make_round_runner(steps_per_round: int, axis_name: str | None):
+    """Build the jittable (m, state, best) -> (state, best) round function:
+    `steps_per_round` annealing steps, a feasible-best snapshot, and (on a
+    mesh) migration of the global best into each shard's worst chain via
+    ICI collectives. ``m`` is an argument (not a closure) so one compiled
+    executable serves every same-shape instance."""
+
+    def one_chain_steps(
+        m: ModelArrays, st: ChainState, temp: jax.Array
+    ) -> ChainState:
+        key, sub = random.split(st.key)
+        bits = random.bits(sub, (steps_per_round, 8), jnp.uint32)
+
+        def body(s, row):
+            return _anneal_step(m, s, temp, row), None
+
+        st, _ = lax.scan(body, st, bits)
+        return ChainState(
+            a=st.a, cnt=st.cnt, lcnt=st.lcnt, rcnt=st.rcnt,
+            pen=st.pen, w=st.w, key=key,
+        )
+
+    batched_steps = jax.vmap(one_chain_steps, in_axes=(None, 0, None))
+
+    def run_round(m: ModelArrays, state: ChainState, best_k: jax.Array,
+                  best_a: jax.Array, temp: jax.Array):
+        state = batched_steps(m, state, temp)
+        k = best_key(state)  # [N]
+        improved = k > best_k
+        best_k = jnp.where(improved, k, best_k)
+        best_a = jnp.where(improved[:, None, None], state.a, best_a)
+
+        if axis_name is not None:
+            # ICI collectives: find the globally best chain this round and
+            # clone it over every shard's worst chain (SURVEY.md §3.4)
+            local_best = jnp.max(k)
+            global_best = lax.pmax(local_best, axis_name)
+            idx = jax.lax.axis_index(axis_name)
+            am_owner = local_best == global_best
+            owner = lax.pmin(jnp.where(am_owner, idx, jnp.iinfo(jnp.int32).max),
+                             axis_name)
+            is_owner = idx == owner
+            src = jnp.argmax(k)
+            leaves = (state.a[src], state.cnt[src], state.lcnt[src],
+                      state.rcnt[src], state.pen[src], state.w[src])
+            zeros = jax.tree.map(jnp.zeros_like, leaves)
+            picked = jax.tree.map(
+                lambda x, z: jnp.where(is_owner, x, z), leaves, zeros
+            )
+            ga, gcnt, glcnt, grcnt, gpen, gw = jax.tree.map(
+                lambda x: lax.psum(x, axis_name), picked
+            )
+            dst = jnp.argmin(k)
+
+            def put(arr, val):
+                return arr.at[dst].set(val)
+
+            state = ChainState(
+                a=put(state.a, ga),
+                cnt=put(state.cnt, gcnt),
+                lcnt=put(state.lcnt, glcnt),
+                rcnt=put(state.rcnt, grcnt),
+                pen=put(state.pen, gpen),
+                w=put(state.w, gw),
+                key=state.key,
+            )
+        return state, best_k, best_a
+
+    return run_round
+
+
+def make_solver_fn(
+    n_chains: int,
+    steps_per_round: int,
+    axis_name: str | None = None,
+):
+    """Full anneal as one jittable function: model + seed [P, R] + base key
+    + temps [rounds] -> (best_a [P, R], best_key scalar, curve [rounds])
+    for this shard. The model AND the temperature ladder are runtime
+    arguments, so one compiled executable covers every same-shape instance
+    and every schedule segment — which is what lets the engine run the
+    anneal in clock-checked chunks (``time_limit_s``) without recompiling
+    per chunk."""
+    run_round = make_round_runner(steps_per_round, axis_name)
+
+    def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
+              temps: jax.Array):
+        keys = random.split(key, n_chains)
+        state = jax.vmap(lambda k: init_chain(m, a_seed, k))(keys)
+        # snapshot the SEED itself before any annealing: high-temperature
+        # rounds may never re-reach a good (often near-optimal) warm start,
+        # so the final answer must be at least as good as the seed
+        best_k = best_key(state)
+        best_a = jnp.broadcast_to(
+            a_seed.astype(jnp.int32), (n_chains, *a_seed.shape)
+        )
+        if axis_name is not None:
+            # under shard_map the chains are device-varying (their RNG keys
+            # are sharded) while seed/model are replicated; the scan carry
+            # must be uniformly varying — pcast only the unvarying leaves
+            def to_varying(x):
+                if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+                    return x
+                return lax.pcast(x, axis_name, to="varying")
+
+            state, best_k, best_a = jax.tree.map(
+                to_varying, (state, best_k, best_a)
+            )
+
+        def body(carry, temp):
+            state, bk, ba = carry
+            state, bk, ba = run_round(m, state, bk, ba, temp)
+            return (state, bk, ba), jnp.max(bk)  # best-score curve point
+
+        (state, best_k, best_a), curve = lax.scan(
+            body, (state, best_k, best_a), temps
+        )
+        top = jnp.argmax(best_k)
+        return best_a[top], best_k[top], curve
+
+    return solve

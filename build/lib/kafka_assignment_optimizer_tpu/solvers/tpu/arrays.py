@@ -1,0 +1,103 @@
+"""Device-array view of a ProblemInstance (the L1-L3 model lowered to HBM).
+
+This is the host->device boundary of the TPU solve stack (SURVEY.md §3.4):
+everything the annealing engine and the scoring kernels need, as a single
+pytree of jnp arrays, replicated across the mesh (the *candidates* are
+sharded, the *model* is not — it is a few MB even at 256 brokers x 10k
+partitions).
+
+Penalty weights: one unit of any constraint violation must always outweigh
+the largest single-step objective gain (a weight-4 leader-keep), so the
+search orders feasibility strictly above preservation while still letting
+high-temperature sweeps tunnel through infeasible states.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.instance import ProblemInstance
+
+# score = SCALE_W * weight - LAMBDA * total_violations
+SCALE_W = 1
+LAMBDA = 64
+
+
+def band_pen(c, lo, hi):
+    """Integer band-violation magnitude of count ``c`` vs [lo, hi] —
+    shared by both annealing engines' accept decisions; must match the
+    numpy oracle (``ProblemInstance.violations``) exactly."""
+    return jnp.maximum(c - hi, 0) + jnp.maximum(lo - c, 0)
+
+
+def u01(bits):
+    """uint32 -> uniform float32 in [0, 1) via the top 24 bits."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24)
+    )
+
+
+def geometric_temps(t_hi: float, t_lo: float, n: int) -> jax.Array:
+    """The shared annealing temperature ladder."""
+    return jnp.asarray(
+        t_hi * (t_lo / t_hi) ** (jnp.arange(n) / max(n - 1, 1)), jnp.float32
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ModelArrays:
+    """Replicated model constants. Shapes: B brokers (+1 null bucket),
+    P partitions, R max replication factor, K racks (+1 null rack)."""
+
+    a0: jax.Array  # [P, R] int32 current assignment, null = B
+    rf: jax.Array  # [P] int32
+    slot_valid: jax.Array  # [P, R] bool
+    w_lead: jax.Array  # [P, B+1] int32
+    w_foll: jax.Array  # [P, B+1] int32
+    rack_of: jax.Array  # [B+1] int32, null broker -> K
+    broker_band: jax.Array  # [2] int32 (lo, hi)
+    leader_band: jax.Array  # [2] int32 (lo, hi)
+    rack_lo: jax.Array  # [K+1] int32 (null rack: 0)
+    rack_hi: jax.Array  # [K+1] int32 (null rack: huge)
+    part_rack_hi: jax.Array  # [P] int32
+
+    @property
+    def num_parts(self) -> int:
+        return self.a0.shape[0]
+
+    @property
+    def max_rf(self) -> int:
+        return self.a0.shape[1]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.w_lead.shape[1] - 1
+
+    @property
+    def num_racks(self) -> int:
+        return self.rack_lo.shape[0] - 1
+
+
+def from_instance(inst: ProblemInstance) -> ModelArrays:
+    B, K = inst.num_brokers, inst.num_racks
+    big = np.iinfo(np.int32).max // 4
+    rack_lo = np.concatenate([inst.rack_lo, [0]]).astype(np.int32)
+    rack_hi = np.concatenate([inst.rack_hi, [big]]).astype(np.int32)
+    return ModelArrays(
+        a0=jnp.asarray(inst.a0, jnp.int32),
+        rf=jnp.asarray(inst.rf, jnp.int32),
+        slot_valid=jnp.asarray(inst.slot_valid),
+        w_lead=jnp.asarray(inst.w_leader, jnp.int32),
+        w_foll=jnp.asarray(inst.w_follower, jnp.int32),
+        rack_of=jnp.asarray(inst.rack_of_broker, jnp.int32),
+        broker_band=jnp.asarray([inst.broker_lo, inst.broker_hi], jnp.int32),
+        leader_band=jnp.asarray([inst.leader_lo, inst.leader_hi], jnp.int32),
+        rack_lo=jnp.asarray(rack_lo),
+        rack_hi=jnp.asarray(rack_hi),
+        part_rack_hi=jnp.asarray(inst.part_rack_hi, jnp.int32),
+    )

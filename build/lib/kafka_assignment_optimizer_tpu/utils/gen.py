@@ -1,0 +1,285 @@
+"""Synthetic cluster/scenario generators — the benchmark suite's inputs.
+
+The reference ships exactly one worked scenario (the 20-broker demo,
+``/root/reference/README.md:27-91``); the five configurations below are
+the new build's benchmark suite (BASELINE.json "configs", SURVEY.md §4.6):
+
+1. ``demo``          the README example (golden acceptance case)
+2. ``scale_out``     64 brokers / 4 racks / 200 topics x 40 parts RF=3, add 8
+3. ``decommission``  256 brokers / 8 racks / 10k parts RF=3, drop one broker
+4. ``rf_change``     RF 2->3 across 1k partitions, strict rack diversity
+5. ``leader_only``   128 brokers / 5k parts, fix leader skew, 0 replica moves
+
+Placement scheme: brokers are ordered round-robin by rack
+(r0b0, r1b0, ..., rK-1b0, r0b1, ...), and partition ``p`` takes the window
+``ordered[(p + s) % B]`` for slot ``s``. Consecutive window entries sit in
+distinct racks whenever RF <= K, so the generated *current* assignments are
+rack-diverse and per-broker/per-rack balanced by construction — realistic
+steady-state clusters, which is exactly what a reassignment starts from.
+"""
+
+from __future__ import annotations
+
+
+from dataclasses import dataclass, replace
+
+from ..models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+    demo_assignment,
+    demo_broker_list,
+    demo_topology,
+)
+
+
+@dataclass
+class Scenario:
+    """One benchmark configuration: the optimizer's full input tuple plus
+    bookkeeping the harness uses to judge plan quality."""
+
+    name: str
+    current: Assignment
+    broker_list: list[int]
+    topology: Topology
+    target_rf: int | None = None
+    # a provable lower bound on replica moves for any feasible plan; when
+    # ``lb_tight`` the bound is known achievable, so the harness's quality
+    # gate requires moves == min_moves_lb (e.g. leader_only: exactly 0)
+    min_moves_lb: int = 0
+    lb_tight: bool = False
+    notes: str = ""
+
+    @property
+    def kwargs(self) -> dict:
+        return dict(
+            current=self.current,
+            broker_list=self.broker_list,
+            topology=self.topology,
+            target_rf=self.target_rf,
+        )
+
+
+def _rack_interleaved(broker_ids: list[int], topology: Topology) -> list[int]:
+    """Order brokers round-robin across racks."""
+    by_rack: dict[str, list[int]] = {}
+    for b in broker_ids:
+        by_rack.setdefault(topology.rack(b), []).append(b)
+    lanes = [sorted(v) for _, v in sorted(by_rack.items())]
+    out: list[int] = []
+    i = 0
+    while len(out) < len(broker_ids):
+        for lane in lanes:
+            if i < len(lane):
+                out.append(lane[i])
+        i += 1
+    return out
+
+
+def balanced_assignment(
+    broker_ids: list[int],
+    topology: Topology,
+    topics: dict[str, int],
+    rf: int,
+) -> Assignment:
+    """Rack-diverse, balanced placement (see module docstring).
+
+    Replica slots are filled *sequentially* through the rack-interleaved
+    order — replica g lands on ``order[g % B]`` — so per-broker totals are
+    exactly floor/ceil(R_tot/B) and per-rack totals exactly proportional,
+    whatever P and B are. The leader of each partition is then chosen
+    greedily as its least-leading replica, keeping leader counts inside
+    the floor/ceil band too: the generated current assignments are fully
+    feasible steady states."""
+    order = _rack_interleaved(broker_ids, topology)
+    B = len(order)
+    lcnt = {b: 0 for b in broker_ids}
+    parts = []
+    g = 0
+    for topic, n_parts in topics.items():
+        for p in range(n_parts):
+            reps = [order[(g + s) % B] for s in range(rf)]
+            g += rf
+            lead = min(reps, key=lambda b: (lcnt[b], b))
+            lcnt[lead] += 1
+            reps = [lead] + [b for b in reps if b != lead]
+            parts.append(
+                PartitionAssignment(topic=topic, partition=p, replicas=reps)
+            )
+    return Assignment(partitions=parts)
+
+
+def _mod_topology(broker_ids: list[int], n_racks: int) -> Topology:
+    return Topology.from_dict(
+        {str(b): f"rack{b % n_racks}" for b in broker_ids}
+    )
+
+
+def demo() -> Scenario:
+    """BASELINE config 1 — the reference's worked example
+    (``README.md:27-91``): 20 brokers, even/odd AZs, 10 partitions RF=2,
+    decommission broker 19. Known optimum: exactly 1 replica move."""
+    return Scenario(
+        name="demo",
+        current=demo_assignment(),
+        broker_list=demo_broker_list(),  # 0..18 (19 removed)
+        topology=demo_topology(),
+        min_moves_lb=1,
+        lb_tight=True,
+        notes="golden: optimal plan moves exactly 1 replica (README.md:85-91)",
+    )
+
+
+def scale_out(
+    n_old: int = 56, n_new: int = 64, n_racks: int = 4,
+    n_topics: int = 200, parts_per_topic: int = 40, rf: int = 3,
+) -> Scenario:
+    """BASELINE config 2 — scale-out rebalance: cluster grew from 56 to 64
+    brokers; rebalance so the 8 empty brokers take their share."""
+    new_list = list(range(n_new))
+    topo = _mod_topology(new_list, n_racks)
+    current = balanced_assignment(
+        list(range(n_old)), topo, {f"t{i}": parts_per_topic for i in range(n_topics)}, rf
+    )
+    # every replica the new brokers must absorb is one unavoidable move:
+    # any feasible plan gives each broker >= floor(R/B) replicas
+    r_tot = n_topics * parts_per_topic * rf
+    lb = (n_new - n_old) * (r_tot // n_new)
+    return Scenario(
+        name="scale_out",
+        current=current,
+        broker_list=new_list,
+        topology=topo,
+        min_moves_lb=lb,
+        notes=f"add {n_new - n_old} brokers; each must reach floor(R/B) replicas",
+    )
+
+
+def decommission(
+    n_brokers: int = 256, n_racks: int = 8,
+    n_topics: int = 100, parts_per_topic: int = 100, rf: int = 3,
+    remove: int | None = None,
+) -> Scenario:
+    """BASELINE config 3 — the headline/north-star scenario: 256 brokers,
+    8 racks, 10k partitions RF=3, single-broker decommission. Minimum moves
+    = the replicas hosted on the removed broker (each must land somewhere
+    else; nothing else is forced to move since remaining-broker bands stay
+    satisfiable)."""
+    all_brokers = list(range(n_brokers))
+    remove = n_brokers - 1 if remove is None else remove
+    topo = _mod_topology(all_brokers, n_racks)
+    current = balanced_assignment(
+        all_brokers, topo, {f"t{i}": parts_per_topic for i in range(n_topics)}, rf
+    )
+    lb = sum(
+        1 for p in current.partitions for b in p.replicas if b == remove
+    )
+    return Scenario(
+        name="decommission",
+        current=current,
+        broker_list=[b for b in all_brokers if b != remove],
+        topology=topo,
+        min_moves_lb=lb,
+        lb_tight=True,
+        notes=f"drop broker {remove}; it hosts {lb} replicas -> min {lb} moves",
+    )
+
+
+def rf_change(
+    n_brokers: int = 32, n_racks: int = 4,
+    n_topics: int = 10, parts_per_topic: int = 100, rf_old: int = 2, rf_new: int = 3,
+) -> Scenario:
+    """BASELINE config 4 — replication-factor increase 2->3 under strict
+    rack diversity (the reference's RF-change use case, README.md:8-10).
+    Every partition gains rf_new - rf_old replicas; each is a move."""
+    brokers = list(range(n_brokers))
+    topo = _mod_topology(brokers, n_racks)
+    current = balanced_assignment(
+        brokers, topo, {f"t{i}": parts_per_topic for i in range(n_topics)}, rf_old
+    )
+    n_parts = n_topics * parts_per_topic
+    return Scenario(
+        name="rf_change",
+        current=current,
+        broker_list=brokers,
+        topology=topo,
+        target_rf=rf_new,
+        min_moves_lb=n_parts * (rf_new - rf_old),
+        lb_tight=True,
+        notes="each partition must gain one replica on a new broker",
+    )
+
+
+def leader_only(
+    n_brokers: int = 128, n_racks: int = 8,
+    n_topics: int = 50, parts_per_topic: int = 100, rf: int = 3,
+) -> Scenario:
+    """BASELINE config 5 — leader-only rebalance: replicas are perfectly
+    placed but leadership is skewed onto a subset of brokers. The optimal
+    plan fixes leader balance with in-place leader swaps: ZERO replica
+    moves. Exercises the engine's lswap move type in isolation."""
+    brokers = list(range(n_brokers))
+    topo = _mod_topology(brokers, n_racks)
+    base = balanced_assignment(
+        brokers, topo, {f"t{i}": parts_per_topic for i in range(n_topics)}, rf
+    )
+    # skew leadership: make the replica with the smallest (id mod 16)
+    # residue the leader — leaders pile onto low-residue brokers while the
+    # replica *sets* stay balanced and rack-diverse
+    parts = []
+    for p in base.partitions:
+        reps = sorted(p.replicas, key=lambda b: (b % 16, b))
+        parts.append(
+            PartitionAssignment(topic=p.topic, partition=p.partition, replicas=reps)
+        )
+    return Scenario(
+        name="leader_only",
+        current=Assignment(partitions=parts),
+        broker_list=brokers,
+        topology=topo,
+        min_moves_lb=0,
+        lb_tight=True,
+        notes="optimal plan has 0 replica moves, only leader swaps",
+    )
+
+
+def jumbo(
+    n_brokers: int = 512, n_racks: int = 16,
+    n_topics: int = 250, parts_per_topic: int = 200, rf: int = 3,
+) -> Scenario:
+    """Beyond the north star: 512 brokers / 16 racks / 50k partitions
+    RF=3 decommission — 5x the headline's partition count (150k replica
+    slots). No BASELINE counterpart; exists to demonstrate the sweep
+    engine's scaling headroom past the size that motivated the rebuild
+    (per-sweep work is O(chains * partitions); sequential depth stays
+    flat)."""
+    sc = decommission(n_brokers=n_brokers, n_racks=n_racks,
+                      n_topics=n_topics, parts_per_topic=parts_per_topic,
+                      rf=rf)
+    return replace(
+        sc, name="jumbo",
+        notes=f"{n_brokers}b/{n_topics * parts_per_topic}-part "
+              f"decommission; {sc.notes}",
+    )
+
+
+SCENARIOS = {
+    "demo": demo,
+    "scale_out": scale_out,
+    "decommission": decommission,
+    "rf_change": rf_change,
+    "leader_only": leader_only,
+    "jumbo": jumbo,
+}
+
+# shrunk per-scenario kwargs for quick CPU smoke runs: the single source of
+# truth shared by bench.py (--smoke) and ops.bench_kernel, so the scenario
+# solve and the embedded kernel micro-bench always measure the same instance
+SMOKE_KWARGS = {
+    "demo": dict(),
+    "scale_out": dict(n_old=12, n_new=16, n_topics=8, parts_per_topic=10),
+    "decommission": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+    "rf_change": dict(n_brokers=16, n_topics=4, parts_per_topic=25),
+    "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+    "jumbo": dict(n_brokers=48, n_topics=10, parts_per_topic=40),
+}
