@@ -35,6 +35,10 @@ Endpoints:
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
 
+``GET /metrics``
+    Prometheus text counters: requests/solves/errors/sheds and solve
+    wall-clock totals (``kao_*``).
+
 Run: ``python -m kafka_assignment_optimizer_tpu.serve --port 8787``.
 """
 
@@ -44,6 +48,7 @@ import argparse
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .api import optimize
@@ -72,6 +77,36 @@ ALLOWED_OPTIONS = frozenset({
 # into each solve unless the client sets a smaller one
 DEFAULT_LOCK_WAIT_S = 30.0
 DEFAULT_MAX_SOLVE_S = 300.0
+
+# service counters (GET /metrics, Prometheus text format); guarded by
+# their own lock so readers never contend with a solve
+_METRICS_LOCK = threading.Lock()
+_METRICS = {
+    "requests_total": 0,      # POST /submit received
+    "solves_total": 0,        # solves completed successfully
+    "errors_total": 0,        # 4xx/5xx responses (excl. 503 sheds)
+    "shed_total": 0,          # 503 saturation sheds
+    "solve_seconds_total": 0.0,
+    "last_solve_seconds": 0.0,
+}
+
+
+def _count(**updates) -> None:
+    with _METRICS_LOCK:
+        for k, v in updates.items():
+            _METRICS[k] += v
+
+
+def render_metrics() -> str:
+    with _METRICS_LOCK:
+        snap = dict(_METRICS)
+    lines = []
+    for k, v in snap.items():
+        name = f"kao_{k}"
+        kind = "counter" if k.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {v}")
+    return "\n".join(lines) + "\n"
 
 
 class ApiError(Exception):
@@ -161,15 +196,22 @@ def handle_submit(
         )
 
     if not _SOLVE_LOCK.acquire(timeout=lock_wait_s):
+        _count(shed_total=1)
         raise ApiError(
             503,
             f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
         )
     try:
+        t0 = time.perf_counter()
         res = optimize(
             current, brokers, topology, target_rf=rf, solver=solver,
             **options,
         )
+        dt = time.perf_counter() - t0
+        with _METRICS_LOCK:
+            _METRICS["solves_total"] += 1
+            _METRICS["solve_seconds_total"] += dt
+            _METRICS["last_solve_seconds"] = dt
     except (ValueError, KeyError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
         raise ApiError(422, f"model rejected inputs: {msg}") from e
@@ -219,15 +261,27 @@ class Handler(BaseHTTPRequestHandler):
         return path.rstrip("/") or "/"
 
     def do_GET(self):
-        if self._route() in ("/", "/healthz"):
+        route = self._route()
+        if route in ("/", "/healthz"):
             self._send(200, handle_healthz())
+        elif route == "/metrics":
+            body = render_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
+            _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
 
     def do_POST(self):
         if self._route() != "/submit":
+            _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
+        _count(requests_total=1)
         try:
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -248,8 +302,11 @@ class Handler(BaseHTTPRequestHandler):
                                     DEFAULT_MAX_SOLVE_S),
             ))
         except ApiError as e:
+            if e.status != 503:
+                _count(errors_total=1)
             self._send(e.status, {"error": str(e)})
         except Exception as e:  # never leak a traceback as a hung socket
+            _count(errors_total=1)
             self._send(500, {"error": f"internal error: {e}"})
 
 

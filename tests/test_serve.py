@@ -190,3 +190,34 @@ def test_submit_time_limit_validation_and_no_mutation():
     payload["options"] = {}
     handle_submit(payload, max_solve_s=60.0)
     assert payload["options"] == {}
+
+
+def test_metrics_endpoint(server_url):
+    """GET /metrics: Prometheus text counters that actually move."""
+    import urllib.request
+
+    def scrape():
+        with urllib.request.urlopen(server_url + "/metrics") as r:
+            assert r.status == 200
+            return {
+                line.split()[0]: float(line.split()[1])
+                for line in r.read().decode().splitlines()
+                if line and not line.startswith("#")
+            }
+
+    before = scrape()
+    status, _ = post(server_url, {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    })
+    assert status == 200
+    after = scrape()
+    assert after["kao_requests_total"] == before["kao_requests_total"] + 1
+    assert after["kao_solves_total"] == before["kao_solves_total"] + 1
+    assert after["kao_last_solve_seconds"] > 0
+    # an invalid request bumps the error counter
+    status, _ = post(server_url, {"brokers": "0-3"})
+    assert status == 400
+    final = scrape()
+    assert final["kao_errors_total"] == after["kao_errors_total"] + 1
