@@ -196,6 +196,9 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         "lb_tight": sc.lb_tight,
         "leader_changes": report["leader_changes"],
         "feasible": report["feasible"],
+        # True when the engine certified the plan against its LP/flow
+        # bounds: provably weight-optimal AND move-optimal
+        "proved_optimal": report["proven_optimal"],
         "objective": report["objective_weight"],
         "objective_ub": report["objective_upper_bound"],
         "brokers": report["brokers"],
@@ -264,6 +267,7 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         "moves": head["moves"],
         "min_moves_lb": head["min_moves_lb"],
         "feasible": head["feasible"],
+        "proved_optimal": head.get("proved_optimal"),
         "engine": head.get("engine"),
         "scorer": head.get("scorer"),
     }

@@ -37,7 +37,14 @@ class OptimizeResult:
             "replica_moves": self.moves.replica_moves,
             "leader_changes": self.moves.leader_changes,
             "objective_weight": self.instance.preservation_weight(self.solve.a),
-            "objective_upper_bound": self.instance.max_weight(),
+            # tightest bound already computed for this instance: the
+            # leader-band LP bound if an engine certificate evaluated it
+            # (memoized), else the cheap unconstrained bound
+            "objective_upper_bound": (
+                self.instance.best_known_weight_ub()
+                if self.instance.best_known_weight_ub() is not None
+                else self.instance.max_weight()
+            ),
             "violations": viol,
             "feasible": all(v == 0 for v in viol.values()),
             "proven_optimal": self.solve.optimal,

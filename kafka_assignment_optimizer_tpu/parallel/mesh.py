@@ -69,14 +69,26 @@ def _compiled_solver(
         # the solver always anneals with axis_name set here (collectives
         # over a singleton axis are free)
         if engine == "sweep":
-            from ..solvers.tpu.sweep import make_sweep_solver_fn
-
             # the chain engine's per-chain budget is rounds*steps_per_round
             # steps; the sweep engine's sequential budget is len(temps)
-            # sweeps (each sweep touches every partition)
-            solve = make_sweep_solver_fn(
+            # sweeps (each sweep touches every partition). The sweep
+            # engine is STATEFUL: chunked solves thread the full chain
+            # populations through, so cutting the ladder for certificate
+            # checks / time limits does not restart the search.
+            from ..solvers.tpu.sweep import make_sweep_stepper_fn
+
+            solve = make_sweep_stepper_fn(
                 chains_per_device, axis_name=AXIS, scorer=scorer
             )
+
+            def shard_fn(m_rep: ModelArrays, state, temps: jax.Array):
+                state = jax.tree.map(lambda x: x[0], state)
+                state, best_a, best_k, curve = solve(m_rep, state, temps)
+                state = jax.tree.map(lambda x: x[None], state)
+                return state, best_a[None], best_k[None], curve[None]
+
+            in_specs = (P(), P(AXIS), P())
+            out_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS))
         else:
             from ..solvers.tpu.anneal import make_solver_fn
 
@@ -84,17 +96,22 @@ def _compiled_solver(
                 chains_per_device, steps_per_round, axis_name=AXIS
             )
 
-        def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array,
-                     keys: jax.Array, temps: jax.Array):
-            best_a, best_k, curve = solve(m_rep, seed_rep, keys[0], temps)
-            return best_a[None], best_k[None], curve[None]
+            def shard_fn(m_rep: ModelArrays, seed_rep: jax.Array,
+                         keys: jax.Array, temps: jax.Array):
+                best_a, best_k, curve = solve(
+                    m_rep, seed_rep, keys[0], temps
+                )
+                return best_a[None], best_k[None], curve[None]
+
+            in_specs = (P(), P(), P(AXIS), P())
+            out_specs = (P(AXIS), P(AXIS), P(AXIS))
 
         fn = jax.jit(
             jax.shard_map(
                 shard_fn,
                 mesh=mesh,
-                in_specs=(P(), P(), P(AXIS), P()),
-                out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 # pallas_call's ShapeDtypeStruct out_shapes carry no vma
                 # annotation, which jax>=0.9's varying-manual-axes check
                 # rejects inside shard_map (found the hard way: the r2 TPU
@@ -106,6 +123,64 @@ def _compiled_solver(
         )
         _COMPILED[cache_key] = fn
     return fn
+
+
+def init_sweep_state(
+    m: ModelArrays,
+    a_seed: jax.Array,
+    key: jax.Array,
+    mesh: Mesh,
+    chains_per_device: int,
+):
+    """Initial sweep-engine population state, tiled over the mesh:
+    every chain on every shard starts at the greedy seed (chains then
+    diverge through their per-shard RNG streams), and the per-chain best
+    snapshots start AT the seed — the engine can never return a plan
+    that ranks below it. The per-shard RNG keys ride in the state, so a
+    chunked schedule consumes exactly the stream an uncut one would.
+
+    The state is placed with the SAME NamedSharding the solver's
+    out_specs produce — otherwise chunk 0 (host layout) and chunk 1+
+    (device layout) would be distinct jit signatures and the heavy
+    executable would compile twice."""
+    n_dev = mesh.devices.size
+    n = chains_per_device
+    a = jnp.asarray(a_seed, jnp.int32)
+    k0, mv0 = _seed_rank_fn()(a, m)
+    n_parts, n_slots = a.shape
+    tile_a = jnp.broadcast_to(a, (n_dev, n, n_parts, n_slots))
+    state = (
+        tile_a,
+        jnp.full((n_dev, n), k0, k0.dtype),
+        jnp.full((n_dev, n), mv0, jnp.int32),
+        tile_a,
+        jax.random.split(key, n_dev),
+    )
+    sh = jax.sharding.NamedSharding(mesh, P(AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), state)
+
+
+_SEED_RANK = None
+
+
+def _seed_rank_fn():
+    """Jitted (best_key, moves) of a single candidate — the eager vmap
+    path dispatches hundreds of tiny ops and costs seconds cold."""
+    global _SEED_RANK
+    if _SEED_RANK is None:
+        from ..ops.score import moves_batch, score_batch
+        from ..solvers.tpu.sweep import best_key
+
+        @jax.jit
+        def f(a, m):
+            s = score_batch(a[None], m)
+            return (
+                best_key(s.weight, s.penalty)[0],
+                moves_batch(a[None], m)[0],
+            )
+
+        _SEED_RANK = f
+    return _SEED_RANK
 
 
 def solve_on_mesh(
@@ -121,6 +196,7 @@ def solve_on_mesh(
     engine: str = "chain",
     temps: jax.Array | None = None,
     scorer: str = "xla",
+    state=None,
 ):
     """Run the annealer sharded over `mesh`; returns the per-shard winners
     ``(best_a [n_dev, P, R], best_k [n_dev], curve [n_dev, rounds])`` as
@@ -129,7 +205,13 @@ def solve_on_mesh(
     curve. ``temps`` (a schedule segment) overrides the default
     ``geometric_temps(t_hi, t_lo, rounds)`` ladder — the engine passes
     per-chunk segments when honoring ``time_limit_s``. ``scorer`` picks
-    the sweep engine's bulk-rescoring path (Pallas kernel on TPU)."""
+    the sweep engine's bulk-rescoring path (Pallas kernel on TPU).
+
+    The sweep engine is stateful: pass ``state`` (from
+    ``init_sweep_state`` or a previous chunk) and the return becomes
+    ``(state', best_a, best_k, curve)`` — chunked schedules continue the
+    same populations. Without ``state`` the seed is expanded into a
+    fresh state first (single-shot path)."""
     from ..solvers.tpu.arrays import geometric_temps
 
     n_dev = mesh.devices.size
@@ -138,6 +220,12 @@ def solve_on_mesh(
     )
     if temps is None:
         temps = geometric_temps(t_hi, t_lo, rounds)
+    if engine == "sweep":
+        if state is None:
+            state = init_sweep_state(
+                m, a_seed, key, mesh, chains_per_device
+            )
+        return fn(m, state, temps)
     keys = jax.random.split(key, n_dev)
     return fn(m, a_seed, keys, temps)
 

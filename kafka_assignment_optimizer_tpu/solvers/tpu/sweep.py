@@ -616,23 +616,51 @@ def make_sweep_solver_fn(
     is a runtime argument so clock-checked chunked solves reuse one
     executable. ``scorer`` selects the bulk-rescoring implementation
     (``_make_scorer``); every scorer yields bit-identical trajectories."""
-    hists, scores, propose, halves = _make_scorer(scorer)
+    stepper = make_sweep_stepper_fn(
+        n_chains, snapshot_every, axis_name, scorer
+    )
+    _, scores, _, _ = _make_scorer(scorer)  # seed-snapshot scoring only
 
     def solve(m: ModelArrays, a_seed: jax.Array, key: jax.Array,
               temps: jax.Array):
-        sweeps = temps.shape[0]
         P, R = a_seed.shape
         a = jnp.broadcast_to(a_seed.astype(jnp.int32), (n_chains, P, R))
         w0, p0 = scores(m, a)
-        best_k = best_key(w0, p0)  # seed snapshot: never return worse
-        # moves is the lexicographic tie-break: weight tiers alias move
-        # counts (keeping one leader == keeping two followers, 4 = 2+2),
-        # so equal-objective plans with different move counts exist and
-        # Metropolis wanders that plateau (delta >= 0 accepts). Tracking
-        # only the key keeps the FIRST plateau point found; the north
-        # star is fewest moves, so ties must prefer fewer.
-        best_mv = moves_batch(a, m)
-        best_a = a
+        # seed snapshot: never return worse than the seed. moves is the
+        # lexicographic tie-break: weight tiers alias move counts
+        # (keeping one leader == keeping two followers, 4 = 2+2), so
+        # equal-objective plans with different move counts exist and
+        # Metropolis wanders that plateau (delta >= 0 accepts); ties
+        # must prefer fewer moves (the north star).
+        state = (a, best_key(w0, p0), moves_batch(a, m), a, key)
+        _, top_a, top_k, curve = stepper(m, state, temps)
+        return top_a, top_k, curve
+
+    return solve
+
+
+def make_sweep_stepper_fn(
+    n_chains: int,
+    snapshot_every: int = 8,
+    axis_name: str | None = None,
+    scorer: str = "xla",
+):
+    """The state-carrying core of the sweep engine: (m, state, temps) ->
+    (state', best_a [P, R], best_key scalar, curve [sweeps]), with state
+    = (a [N, P, R] current chains, best_k [N], best_mv [N], best_a
+    [N, P, R] per-chain snapshots, key). Chunked solves
+    (``engine.solve_tpu`` cuts the ladder for certificate checks and
+    time limits) thread the FULL state — populations and the RNG key —
+    through the boundaries, so as long as the chunk length preserves the
+    snapshot cadence and the exchange-sweep parity (engine chunks are a
+    multiple of snapshot_every), a chunked run is bit-identical to the
+    uncut ladder: chunking changes only where the host may look, never
+    the search trajectory."""
+    hists, scores, propose, halves = _make_scorer(scorer)
+
+    def solve(m: ModelArrays, state, temps: jax.Array):
+        sweeps = temps.shape[0]
+        a, best_k, best_mv, best_a, key = state
 
         if axis_name is not None:
             def to_varying(x):
@@ -741,6 +769,9 @@ def make_sweep_solver_fn(
         top = jnp.argmin(
             jnp.where(tied, best_mv, jnp.iinfo(jnp.int32).max)
         )
-        return best_a[top], best_k[top], curve
+        return (
+            (a, best_k, best_mv, best_a, key),
+            best_a[top], best_k[top], curve,
+        )
 
     return solve

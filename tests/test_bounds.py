@@ -1,0 +1,165 @@
+"""Optimality bounds + certificates (move lower bounds, weight upper
+bounds, exact leader reseat) — the machinery behind the TPU engine's
+``proved_optimal`` / early-stop (SURVEY.md §7 hard part 1: "matching
+lp_solve's optimality").
+
+Oracle: the exact MILP backend (``solvers/milp.py``), which solves the
+same 0-1 model the reference hands to lp_solve
+(``/root/reference/README.md:106-185``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.api import optimize
+from kafka_assignment_optimizer_tpu.models.instance import build_instance
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _inst(name, smoke=True):
+    kw = gen.SMOKE_KWARGS[name] if smoke else {}
+    sc = gen.SCENARIOS[name](**kw)
+    return sc, build_instance(
+        sc.current, sc.broker_list, sc.topology, target_rf=sc.target_rf
+    )
+
+
+@pytest.mark.parametrize("name", list(gen.SCENARIOS))
+def test_move_lower_bound_matches_scenario_bounds(name):
+    """The generic counting bound reproduces every hand-derived
+    per-scenario bound of utils/gen.py at full size."""
+    sc, inst = _inst(name, smoke=False)
+    lb = inst.move_lower_bound()
+    assert lb >= sc.min_moves_lb
+    if sc.lb_tight:
+        # the scenario bound is known achievable, so a stronger generic
+        # bound would be unsound
+        assert lb == sc.min_moves_lb
+
+
+@pytest.mark.parametrize("name", ["demo", "decommission", "leader_only",
+                                  "scale_out", "rf_change"])
+def test_weight_upper_bound_vs_exact_milp(name):
+    """Tiered weight UBs are valid (>= MILP optimum) and the tight tier
+    is exact on every smoke BASELINE scenario."""
+    sc, inst = _inst(name)
+    r = optimize(solver="milp", **sc.kwargs)
+    opt = r.solve.objective
+    assert r.solve.optimal
+    t0 = inst.weight_upper_bound()
+    t1 = inst.weight_upper_bound(tight=True)
+    assert t0 >= t1 >= opt
+    assert t1 == opt, f"tight weight UB not exact on {name}"
+
+
+@pytest.mark.parametrize("name", ["demo", "decommission", "scale_out"])
+def test_move_lower_bound_exact_valid(name):
+    """The max-flow bound never exceeds the moves of the exact
+    weight-optimal plan (which, on these scenarios, is move-optimal)."""
+    sc, inst = _inst(name)
+    r = optimize(solver="milp", **sc.kwargs)
+    assert inst.move_lower_bound_exact() <= r.replica_moves
+    assert inst.move_lower_bound_exact() >= inst.move_lower_bound()
+
+
+def test_certify_optimal_on_milp_solution():
+    """The certificate recognizes an exact solver's plan as optimal on a
+    scenario where both bounds are tight."""
+    sc, inst = _inst("decommission")
+    r = optimize(solver="milp", **sc.kwargs)
+    assert inst.certify_optimal(r.solve.a)
+
+
+def test_certificate_rejects_suboptimal():
+    """A feasible but clearly suboptimal plan must NOT certify."""
+    sc, inst = _inst("leader_only")
+    # the identity plan is feasible for leader_only? — no: leadership is
+    # skewed, so leader bands are violated; use the MILP plan but break
+    # its weight by demoting every leader to a follower slot
+    r = optimize(solver="milp", **sc.kwargs)
+    a = np.asarray(r.solve.a).copy()
+    a[:, [0, 1]] = a[:, [1, 0]]  # swap leader with first follower
+    assert not inst.certify_optimal(a)
+
+
+def test_best_leader_assignment_exact_on_leader_only():
+    """With replica sets fixed, the transportation reseat reaches the
+    exact optimum (this scenario's optimum moves no replicas at all)."""
+    sc, inst = _inst("leader_only")
+    r = optimize(solver="milp", **sc.kwargs)
+    opt = r.solve.objective
+    # start from the skewed CURRENT assignment (feasible replica sets,
+    # infeasible/suboptimal leadership) and reseat exactly
+    fixed = inst.best_leader_assignment(inst.a0)
+    assert inst.is_feasible(fixed)
+    assert inst.preservation_weight(fixed) == opt
+    assert inst.move_count(fixed) == 0
+
+
+def test_best_leader_assignment_never_regresses():
+    """Reseat output is always >= input weight and preserves
+    feasibility, on every smoke scenario's TPU plan."""
+    for name in gen.SCENARIOS:
+        sc, inst = _inst(name)
+        r = optimize(solver="tpu", seed=1, **sc.kwargs)
+        a = np.asarray(r.solve.a)
+        out = inst.best_leader_assignment(a)
+        assert inst.preservation_weight(out) >= inst.preservation_weight(a)
+        if inst.is_feasible(a):
+            assert inst.is_feasible(out)
+        # a reseat permutes within partitions: replica SETS unchanged
+        assert all(
+            set(row_a[inst.slot_valid[p]]) == set(row_o[inst.slot_valid[p]])
+            for p, (row_a, row_o) in enumerate(zip(a, out))
+        )
+
+
+def test_engine_proves_optimality():
+    """On scenarios with tight bounds the sweep engine's final plan
+    carries the optimality certificate."""
+    sc, _ = _inst("decommission")
+    r = optimize(solver="tpu", seed=0, **sc.kwargs)
+    s = r.solve.stats
+    assert s["feasible"]
+    assert s["proved_optimal"]
+    assert r.solve.optimal
+    assert s["moves"] == s["moves_lb"]
+
+
+def test_engine_early_stops_with_proof():
+    """With the bounds already memoized (prewarmed), the boundary
+    certificate fires deterministically and the engine stops early. (In
+    production the bounds prefetch races the ladder — the non-blocking
+    check just makes early-stop opportunistic.)"""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu
+
+    sc, inst = _inst("decommission")
+    inst.move_lower_bound_exact()
+    inst.weight_upper_bound()
+    # the sweep engine (the TPU default) is the chunked/stateful one —
+    # the chain engine runs one uncut ladder unless a deadline forces
+    # chunking. cert_min_savings_s=0 disables the "is stopping early
+    # even worth it" economics so the check is deterministic.
+    res = solve_tpu(inst, seed=0, engine="sweep", cert_min_savings_s=0.0)
+    s = res.stats
+    assert s["feasible"]
+    assert s["proved_optimal"]
+    assert s["early_stopped"]
+    assert s["rounds_run"] < s["rounds"]
+    assert s["moves"] == s["moves_lb"]
+
+
+def test_engine_unprovable_still_solves():
+    """Where the relaxation has a gap (smoke jumbo), the engine must run
+    the full ladder and still return a feasible plan, with
+    proved_optimal honestly False."""
+    sc, _ = _inst("jumbo")
+    r = optimize(solver="tpu", seed=0, **sc.kwargs)
+    s = r.solve.stats
+    assert s["feasible"]
+    assert s["rounds_run"] == s["rounds"]
+    # jumbo smoke's true optimum (27 moves, MILP-verified) sits above the
+    # relaxation bound (25) — the engine must not claim a proof there
+    assert not s["proved_optimal"] or s["moves"] == s["moves_lb"]

@@ -234,7 +234,7 @@ def test_sweep_pallas_scorer_inside_shard_map(rng):
     mesh = make_mesh()
     outs = {}
     for scorer in ("xla", "pallas-interpret"):
-        pop_a, pop_k, _curve = solve_on_mesh(
+        _state, pop_a, pop_k, _curve = solve_on_mesh(
             m, seed, jax.random.PRNGKey(3), mesh,
             chains_per_device=2, rounds=8, steps_per_round=1,
             engine="sweep", scorer=scorer,
