@@ -276,47 +276,52 @@ class ProblemInstance:
         )
         return wl + foll_sum, s_rm1, ids
 
-    def weight_upper_bound(self, tight: bool = False) -> int:
+    def weight_upper_bound(self, tight: bool = False, level: int = 0
+                           ) -> int:
         """A constraint-aware upper bound on any feasible plan's
         preservation weight — ``max_weight`` tightened by the balance
         constraints that couple partitions through the objective.
 
-        Tiered by cost, each tier memoized, callers escalate only when
-        the cheaper tier fails to certify:
+        Leveled by cost, each level memoized, callers escalate only
+        when the cheaper level fails to certify:
 
-        - tier 0 (``tight=False``, free-ish): ``max_weight`` refined by
-          the leader-transportation LP — leadership gains under the
+        - level 0 (``tight=False``, cheap): ``max_weight`` refined by
+          the leader-cap transportation LP — leadership gains under the
           per-broker ``leader_hi`` cap (integral polytope, HiGHS via
-          scipy, ~0.5 s at 10k partitions). Tight whenever follower
-          keeps are unconstrained (demo, decommission, rf_change,
-          leader-only).
-        - tier 1 (``tight=True``): the kept-replica LP
-          (``_kept_weight_lp``), which also caps follower keeps per
-          broker/rack — needed when brokers are over-full (scale-out).
-          Several seconds at 10k partitions, so only evaluated on
-          explicit request.
+          scipy, ~1 s at 10k partitions). Tight whenever lower bands
+          and follower caps don't bind (demo, decommission, rf_change).
+        - level 1: the same LP with per-broker zero-gain-lead slacks,
+          the leader band's LOWER side, and the total-leads equality —
+          needed when under-leading brokers are FORCED to take
+          leaderships (leader-skew rebalances).
+        - level 2 (``tight=True``): the joint kept-replica LP
+          (``_kept_weight_lp``), which also bands follower keeps and
+          forced new replicas per broker/rack — needed when brokers are
+          over-full (scale-out). Seconds at 10k partitions, so only on
+          explicit request (the engine runs it on a worker thread).
 
-        The engines' optimality certificates try tier 0, then tier 1."""
+        ``certify_optimal`` escalates 0 -> 1 -> 2."""
+        level = 2 if tight else level
         memo = getattr(self, "_wub_memo", None)
         if memo is None:
             memo = {}
             self._wub_memo = memo
-        if "t0" not in memo:
-            lead = self._leader_cap_lp()
+        if 0 not in memo:
+            lead = self._leader_cap_lp(with_lower=False)
             mw = self.max_weight()
-            memo["t0"] = mw if lead is None else min(mw, lead)
-        if tight and "t1" not in memo:
-            # LP cost grows superlinearly in member count; past ~60k
-            # members (20k partitions at RF=3) stick with tier 0 rather
-            # than stall a certificate check for tens of seconds
-            if self._members()[0].size > 60_000:
-                memo["t1"] = memo["t0"]
-            else:
-                kept = self._kept_weight_lp()
-                memo["t1"] = (
-                    memo["t0"] if kept is None else min(memo["t0"], kept)
-                )
-        return memo["t1"] if tight and "t1" in memo else memo["t0"]
+            memo[0] = mw if lead is None else min(mw, lead)
+        # LP cost grows superlinearly in member count; past ~60k members
+        # (20k partitions at RF=3) the higher levels stick with the
+        # cheaper bound rather than stall a certificate check for tens
+        # of seconds (a HiGHS time_limit bounds them regardless)
+        big = level >= 1 and self._members()[0].size > 60_000
+        if level >= 1 and 1 not in memo:
+            lead = None if big else self._leader_cap_lp(with_lower=True)
+            memo[1] = memo[0] if lead is None else min(memo[0], lead)
+        if level >= 2 and 2 not in memo:
+            kept = None if big else self._kept_weight_lp()
+            memo[2] = memo[1] if kept is None else min(memo[1], kept)
+        return memo[level]
 
     def best_known_weight_ub(self) -> int | None:
         """The tightest weight upper bound evaluated so far (for
@@ -410,17 +415,26 @@ class ProblemInstance:
         except Exception:
             return None
 
-    def _leader_cap_lp(self) -> int | None:
-        """Tier-0 refinement: max_weight with the per-broker leadership
-        cap modeled exactly. Each partition either hands leadership to a
-        member m (gain = val[p,m] - s_rm1 over the non-member-leader
-        optimum) or not; each broker accepts at most ``leader_hi`` —
-        a transportation LP (integral)."""
+    def _leader_cap_lp(self, with_lower: bool = False) -> int | None:
+        """max_weight with the per-broker leadership constraints modeled
+        exactly. Each partition either hands leadership to a member m
+        (gain = val[p,m] - s_rm1 over the non-member-leader optimum) or
+        to some zero-gain leader; each broker accepts at most
+        ``leader_hi`` — a transportation LP (integral).
+
+        ``with_lower`` additionally introduces per-broker slack
+        variables y_b counting the zero-gain leads, the band's LOWER
+        side, and the total-leads equality. The lower band matters for
+        leader-skew rebalances: under-leading brokers are FORCED to
+        take leaderships away from gainful keeps, a loss the cap-only
+        model cannot see — but the slack formulation solves ~3x slower,
+        so it is a separate, lazier bound level."""
         r = self._leader_vals()
         if r is None:
             return 0
         val, s_rm1, ids = r
         active = self.rf > 0
+        p_active = int(active.sum())
         base = int(s_rm1[active].sum())
         gain = np.where(
             (ids >= 0) & active[:, None],
@@ -435,32 +449,62 @@ class ProblemInstance:
             import scipy.sparse as sp
             from scipy.optimize import linprog
 
+            B = self.num_brokers
             g = gain[rows, cols].astype(np.float64)
             b_of = ids[rows, cols]
             n = rows.size
             var = np.arange(n)
-            a_ub = sp.vstack(
-                [
-                    sp.csr_matrix(  # one leading member per partition
-                        (np.ones(n), (rows, var)),
-                        shape=(self.num_parts, n),
+            per_part = sp.csr_matrix(  # one leading member each
+                (np.ones(n), (rows, var)), shape=(self.num_parts, n)
+            )
+            cap = sp.csr_matrix((np.ones(n), (b_of, var)), shape=(B, n))
+            if not with_lower:
+                res = linprog(
+                    -g,
+                    A_ub=sp.vstack([per_part, cap], format="csr"),
+                    b_ub=np.concatenate(
+                        [np.ones(self.num_parts),
+                         np.full(B, float(self.leader_hi))]
                     ),
-                    sp.csr_matrix(  # per-broker leadership cap
-                        (np.ones(n), (b_of, var)),
-                        shape=(self.num_brokers, n),
-                    ),
-                ],
-                format="csr",
-            )
-            b_ub = np.concatenate(
-                [
-                    np.ones(self.num_parts),
-                    np.full(self.num_brokers, float(self.leader_hi)),
-                ]
-            )
-            res = linprog(
-                -g, A_ub=a_ub, b_ub=b_ub, bounds=(0, 1), method="highs"
-            )
+                    bounds=(0, 1),
+                    method="highs",
+                    options={"time_limit": 30},
+                )
+            else:
+                # columns: x (gainful member leads) then y (per-broker
+                # zero-gain lead slack)
+                led_of_b = sp.hstack(
+                    [cap, sp.eye(B, format="csr")], format="csr"
+                )
+                a_ub = sp.vstack(
+                    [
+                        sp.hstack(
+                            [per_part,
+                             sp.csr_matrix((self.num_parts, B))],
+                            format="csr",
+                        ),
+                        led_of_b,        # <= leader_hi
+                        -led_of_b,       # >= leader_lo
+                    ],
+                    format="csr",
+                )
+                b_ub = np.concatenate(
+                    [
+                        np.ones(self.num_parts),
+                        np.full(B, float(self.leader_hi)),
+                        np.full(B, -float(self.leader_lo)),
+                    ]
+                )
+                res = linprog(
+                    -np.concatenate([g, np.zeros(B)]),
+                    A_ub=a_ub, b_ub=b_ub,
+                    # every live partition has exactly one leader
+                    A_eq=sp.csr_matrix(np.ones((1, n + B))),
+                    b_eq=np.array([float(p_active)]),
+                    bounds=[(0, 1)] * n + [(0, float(p_active))] * B,
+                    method="highs",
+                    options={"time_limit": 30},
+                )
             if not res.success:
                 return None
             return base + int(np.floor(-res.fun + 1e-7))
@@ -468,21 +512,30 @@ class ProblemInstance:
             return None
 
     def _kept_weight_lp(self) -> int | None:
-        """Tier-1 bound: max preservation weight of kept slots under ALL
-        cap families jointly (see ``weight_upper_bound``). Variables
-        x_{p,b} (kept as follower) / y_{p,b} (kept as leader) per member:
+        """Level-2 bound: max preservation weight of kept slots under
+        ALL band families jointly, BOTH sides (see
+        ``weight_upper_bound``). Variables: x_{p,b} (member kept as
+        follower, weight w_follower) / y_{p,b} (member kept as leader,
+        weight w_leader) per current eligible member, plus zero-weight
+        slacks u_b (partitions broker b leads through a non-kept
+        leader) and z_b (new, non-kept replicas broker b hosts):
 
             x + y <= 1                    per member (one role)
             sum_b y <= 1                  per partition (C5)
             sum_b (x+y) <= rf_p           per partition (C4)
             sum_{b in k} (x+y) <= part_rack_hi_p   per (p, rack) (C10)
-            sum_p y <= leader_hi          per broker (C7)
-            sum_p (x+y) <= broker_hi      per broker (C6)
-            sum_{b in k, p} (x+y) <= rack_hi_k     per rack (C9)
+            leader_lo <= sum_p y->b + u_b <= leader_hi   per broker (C7)
+            broker_lo <= sum (x+y)->b + z_b <= broker_hi per broker (C6)
+            rack_lo_k <= sum_{b in k} [(x+y)->b + z_b] <= rack_hi_k (C9)
+            sum y + sum u = #live partitions       (one leader each)
+            sum (x+y) + sum z = total_replicas     (every slot filled)
 
-        Lower bands bind through *new* replicas, which carry no weight
-        and only consume cap slack; dropping them keeps the optimum a
-        valid upper bound."""
+        Every feasible plan maps into this region (kept roles -> x/y,
+        its remaining leads/replicas -> u/z), so the optimum is a valid
+        upper bound; the slacks let the LOWER bands and totals bind —
+        an under-leading broker must absorb leaderships and a
+        below-floor broker/rack must absorb new replicas, losses the
+        cap-only levels cannot see."""
         try:
             import scipy.sparse as sp
             from scipy.optimize import linprog
@@ -499,30 +552,53 @@ class ProblemInstance:
             one = np.ones(n)
             pair_key = mrows.astype(np.int64) * K + rack
             pairs, pair_idx = np.unique(pair_key, return_inverse=True)
+            p_active = int((self.rf > 0).sum())
+            r_total = float(self.total_replicas)
+            # column layout: x (kept follower) 0..n-1 | y (kept leader)
+            # n..2n-1 | u (non-kept lead per broker) 2n..2n+B-1 | z (new
+            # replica per broker) 2n+B..2n+2B-1. The slack columns let
+            # the LOWER bands and the totals bind: an under-leading
+            # broker must take leads (losing 4->2 keeps elsewhere), new
+            # replicas forced by broker/rack floors consume cap the
+            # kept slots then cannot use.
+            ncols = 2 * n + 2 * B
+            u_off, z_off = 2 * n, 2 * n + B
 
-            # explicit column offsets: x vars 0..n-1, y vars n..2n-1
-            def both(r, shape0):  # rows over x+y
+            def block(r, c, shape0):
                 return sp.csr_matrix(
-                    (np.concatenate([one, one]),
-                     (np.concatenate([r, r]),
-                      np.concatenate([var, var + n]))),
-                    shape=(shape0, 2 * n),
+                    (np.ones(len(c)), (r, c)), shape=(shape0, ncols)
+                )
+
+            def both(r, shape0):  # rows over x+y
+                return block(
+                    np.concatenate([r, r]),
+                    np.concatenate([var, var + n]),
+                    shape0,
                 )
 
             def y_only(r, shape0):
-                return sp.csr_matrix(
-                    (one, (r, var + n)), shape=(shape0, 2 * n)
-                )
+                return block(r, var + n, shape0)
 
+            b_idx = np.arange(B)
+            lead_of_b = y_only(mcols, B) + block(
+                b_idx, u_off + b_idx, B
+            )
+            repl_of_b = both(mcols, B) + block(b_idx, z_off + b_idx, B)
+            rack_rows = both(rack, K) + block(
+                self.rack_of_broker[:B], z_off + b_idx, K
+            )
             a_ub = sp.vstack(
                 [
                     both(var, n),          # x + y <= 1 per member
                     y_only(mrows, P),      # one kept leader per part
                     both(mrows, P),        # <= rf per part
                     both(pair_idx, pairs.size),  # diversity per (p,k)
-                    y_only(mcols, B),      # <= leader_hi per broker
-                    both(mcols, B),        # <= broker_hi per broker
-                    both(rack, K),         # <= rack_hi per rack
+                    lead_of_b,             # <= leader_hi per broker
+                    -lead_of_b,            # >= leader_lo per broker
+                    repl_of_b,             # <= broker_hi per broker
+                    -repl_of_b,            # >= broker_lo per broker
+                    rack_rows,             # <= rack_hi per rack
+                    -rack_rows,            # >= rack_lo per rack
                 ],
                 format="csr",
             )
@@ -533,17 +609,45 @@ class ProblemInstance:
                     self.rf.astype(np.float64),
                     self.part_rack_hi[(pairs // K)].astype(np.float64),
                     np.full(B, float(self.leader_hi)),
+                    np.full(B, -float(self.leader_lo)),
                     np.full(B, float(self.broker_hi)),
+                    np.full(B, -float(self.broker_lo)),
                     self.rack_hi.astype(np.float64),
+                    -self.rack_lo.astype(np.float64),
                 ]
             )
+            # totals: every live partition has one leader; every valid
+            # slot is kept or new
+            a_eq = sp.vstack(
+                [
+                    block(
+                        np.zeros(n + B, np.int64),
+                        np.concatenate([var + n, u_off + b_idx]),
+                        1,
+                    ),
+                    block(
+                        np.zeros(2 * n + B, np.int64),
+                        np.concatenate([var, var + n, z_off + b_idx]),
+                        1,
+                    ),
+                ],
+                format="csr",
+            )
+            b_eq = np.array([float(p_active), r_total])
             wl = self.w_leader[:, :B][mrows, mcols].astype(np.float64)
             wf = np.maximum(
                 self.w_follower[:, :B][mrows, mcols], 0
             ).astype(np.float64)
+            bounds = (
+                [(0, 1)] * (2 * n)
+                + [(0, float(p_active))] * B
+                + [(0, r_total)] * B
+            )
             res = linprog(
-                -np.concatenate([wf, wl]),
-                A_ub=a_ub, b_ub=b_ub, bounds=(0, 1), method="highs",
+                -np.concatenate([wf, wl, np.zeros(2 * B)]),
+                A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
+                bounds=bounds, method="highs",
+                options={"time_limit": 30},
             )
             if not res.success:
                 return None
@@ -709,12 +813,16 @@ class ProblemInstance:
         ):
             return False
         w = self.preservation_weight(a)
-        if w >= self.weight_upper_bound():
+        if w >= self.weight_upper_bound(level=0):
             return True
-        # the tight tier solves a multi-second LP at 10k partitions;
+        # the higher levels solve multi-second LPs at 10k partitions;
         # deadline-sensitive callers (the engine under time_limit_s)
         # disable the synchronous escalation
-        return allow_tight and w >= self.weight_upper_bound(tight=True)
+        if not allow_tight:
+            return False
+        return w >= self.weight_upper_bound(level=1) or (
+            w >= self.weight_upper_bound(level=2)
+        )
 
 
 
