@@ -511,7 +511,7 @@ class ProblemInstance:
         except Exception:
             return None
 
-    def _kept_weight_lp(self) -> int | None:
+    def _kept_weight_lp(self, return_solution: bool = False):
         """Level-2 bound: max preservation weight of kept slots under
         ALL band families jointly, BOTH sides (see
         ``weight_upper_bound``). Variables: x_{p,b} (member kept as
@@ -544,7 +544,7 @@ class ProblemInstance:
         mrows, mcols = self._members()
         n = mrows.size
         if n == 0:
-            return 0
+            return (0, None) if return_solution else 0
         try:
             B, K, P = self.num_brokers, self.num_racks, self.num_parts
             rack = self.rack_of_broker[mcols]
@@ -643,14 +643,38 @@ class ProblemInstance:
                 + [(0, float(p_active))] * B
                 + [(0, r_total)] * B
             )
+            if return_solution:
+                # one composite solve: weight lexicographically above
+                # the kept-slot count (kept < n+1, so the scaled weight
+                # term dominates) — among weight-optimal vertices, pick
+                # a move-minimal one for the constructor to decode. The
+                # decoded plan's weight/moves are recomputed from the
+                # ROUNDED integers, so composite-objective fp noise
+                # cannot leak into any certificate.
+                scale = float(n + 1)
+                c = -np.concatenate(
+                    [scale * wf + 1, scale * wl + 1, np.zeros(2 * B)]
+                )
+            else:
+                c = -np.concatenate([wf, wl, np.zeros(2 * B)])
             res = linprog(
-                -np.concatenate([wf, wl, np.zeros(2 * B)]),
+                c,
                 A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
                 bounds=bounds, method="highs",
                 options={"time_limit": 30},
             )
             if not res.success:
                 return None
+            if return_solution:
+                sol = res.x
+                return None, {
+                    "x": sol[:n],
+                    "y": sol[n:2 * n],
+                    "u": sol[u_off:u_off + B],
+                    "z": sol[z_off:z_off + B],
+                    "mrows": mrows,
+                    "mcols": mcols,
+                }
             # floor-with-epsilon keeps the value a true upper bound on
             # the integer optimum
             return int(np.floor(-res.fun + 1e-7))

@@ -1,0 +1,200 @@
+"""LP-rounding plan constructor — decode the kept-replica LP's vertex
+into an actual reassignment plan.
+
+The level-2 weight bound (``ProblemInstance._kept_weight_lp``) is a
+transportation-structured LP whose optimum is (almost always) an
+INTEGRAL vertex: x/y say exactly which current members stay and in which
+role, z says how many new replicas each broker absorbs, u how many
+leaderships land on non-kept leaders. When the caps genuinely bind —
+scale-outs over-filling old brokers, leader-skew rebalances — local
+search burns its whole ladder approaching that structure from below;
+this module instead materializes it directly:
+
+1. round x/y/z (bail to None on a fractional vertex),
+2. place the kept members,
+3. complete the vacant slots with new replicas via one max-flow
+   (partitions -> (partition, rack) diversity nodes -> brokers with
+   z-quota), so every band and diversity cap holds by construction,
+4. reseat leaders exactly (``best_leader_assignment``).
+
+If the result is feasible and meets the weight bound it IS a proven
+global optimum and the engine can skip annealing entirely; otherwise it
+still seeds the population at (or near) the LP structure. Returns None
+whenever any step cannot complete — callers always have the greedy seed
+to fall back on.
+
+No counterpart in the reference (its lp_solve run IS the exact solve,
+``/root/reference/README.md:135-137``); this is the TPU build's bridge
+between the search engine and exact optimality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.instance import ProblemInstance
+
+
+def construct(inst: ProblemInstance) -> np.ndarray | None:
+    """Decode the kept-replica LP into a full plan, or None."""
+    try:
+        out = inst._kept_weight_lp(return_solution=True)
+    except Exception:
+        return None
+    if not isinstance(out, tuple) or out[1] is None:
+        return None
+    _, sol = out
+    x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
+    z = np.asarray(sol["z"])
+    mrows, mcols = sol["mrows"], sol["mcols"]
+
+    # integral vertex required: kept roles and new-replica quotas must
+    # be whole (transportation structure makes this the common case)
+    if (
+        np.abs(x - np.rint(x)).max(initial=0) > 1e-6
+        or np.abs(y - np.rint(y)).max(initial=0) > 1e-6
+        or np.abs(z - np.rint(z)).max(initial=0) > 1e-6
+    ):
+        return None
+    xi = np.rint(x).astype(bool)
+    yi = np.rint(y).astype(bool)
+    quota = np.rint(z).astype(np.int64)
+
+    P, R = inst.num_parts, inst.max_rf
+    B, K = inst.num_brokers, inst.num_racks
+    rf = inst.rf.astype(np.int64)
+    valid = inst.slot_valid
+
+    # place kept members sequentially per partition — slot ORDER is
+    # irrelevant here because the final exact leader reseat permutes
+    # each row anyway
+    keep = xi | yi
+    kr, kb = mrows[keep], mcols[keep]
+    order = np.argsort(kr, kind="stable")
+    kr, kb = kr[order], kb[order]
+    first = np.r_[True, kr[1:] != kr[:-1]] if kr.size else np.array([], bool)
+    start = np.maximum.accumulate(
+        np.where(first, np.arange(kr.size), 0)
+    ) if kr.size else kr
+    rank = np.arange(kr.size) - start
+    if kr.size and (rank >= rf[kr]).any():
+        return None  # vertex kept more slots than the partition has
+    a = np.full((P, R), B, dtype=np.int64)
+    a[kr, rank] = kb
+
+    kept_cnt = (a != B).sum(axis=1)
+    vac = rf - kept_cnt  # >= 0: the rank check above caps keeps at rf
+    need = int(vac.sum())
+    if need != int(quota.sum()):
+        return None
+    if need > 0:
+        assign = _complete_maxflow(inst, a, vac, quota)
+        if assign is None:
+            return None
+        for p, b in assign:
+            row = a[p]
+            vac_slots = np.flatnonzero((row == B) & valid[p])
+            a[p, vac_slots[0]] = b
+    if ((a == B) & valid).any():
+        return None
+
+    a = a.astype(np.int32)
+    a = inst.best_leader_assignment(a)
+    if not inst.is_feasible(a):
+        return None
+    return a
+
+
+def _complete_maxflow(inst, a, vac, quota):
+    """Assign each vacancy a (partition, broker) pair: max-flow over
+    partitions -> (p, rack) diversity nodes -> quota brokers. Returns
+    [(p, broker)] or None if the vacancies cannot all be placed."""
+    try:
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import maximum_flow
+    except Exception:
+        return None
+    P, R = a.shape
+    B, K = inst.num_brokers, inst.num_racks
+    rack_of = inst.rack_of_broker[:B].astype(np.int64)
+    qb = np.flatnonzero(quota > 0)
+    if qb.size == 0:
+        return None
+    # per-(p, rack) remaining diversity allowance
+    kept_rack = np.zeros((P, K + 1), dtype=np.int64)
+    filled = a != B
+    np.add.at(
+        kept_rack,
+        (np.arange(P)[:, None].repeat(R, 1)[filled],
+         inst.rack_of_broker[a[filled]]),
+        1,
+    )
+    rem = inst.part_rack_hi[:, None] - kept_rack[:, :K]  # [P, K]
+
+    # sparse (p, k) pair nodes: only racks holding quota brokers, only
+    # partitions with vacancies and remaining allowance. Fully
+    # vectorized — at 50k partitions x 100 quota brokers the Python
+    # per-edge version costs seconds of host CPU.
+    qr = np.unique(rack_of[qb])
+    pv = np.flatnonzero(vac > 0)
+    if pv.size == 0 or qr.size == 0:
+        return None
+    grid_p = np.repeat(pv, qr.size)
+    grid_k = np.tile(qr, pv.size)
+    keep = rem[grid_p, grid_k] > 0
+    pk_p, pk_k = grid_p[keep], grid_k[keep]
+    U = pk_p.size
+    if U == 0:
+        return None
+    # pair lookup: index into the dense (p, k) grid
+    pair_of = np.full(P * K, -1, dtype=np.int64)
+    pair_of[pk_p * K + pk_k] = np.arange(U)
+
+    # membership mask to forbid brokers already in the partition
+    in_part = np.zeros((P, B + 1), dtype=bool)
+    rows_f, cols_f = np.nonzero(filled)
+    in_part[rows_f, a[rows_f, cols_f]] = True
+
+    o_part, o_pair = 1, 1 + P
+    o_brok = 1 + P + U
+    t = o_brok + B
+    src, dst, cap = [], [], []
+    # s -> partition
+    src.append(np.zeros(pv.size, np.int64))
+    dst.append(o_part + pv)
+    cap.append(vac[pv])
+    # partition -> pair
+    src.append(o_part + pk_p)
+    dst.append(o_pair + np.arange(U))
+    cap.append(np.minimum(rem[pk_p, pk_k], vac[pk_p]))
+    # pair -> broker (cap 1 per (p, b); skip members already in p):
+    # cross every quota broker with every pair node of its rack
+    eb_p = np.repeat(pv, qb.size)        # candidate partition
+    eb_b = np.tile(qb, pv.size)          # candidate broker
+    pid = pair_of[eb_p * K + rack_of[eb_b]]
+    ok_e = (pid >= 0) & ~in_part[eb_p, eb_b]
+    if not ok_e.any():
+        return None
+    src.append(o_pair + pid[ok_e])
+    dst.append(o_brok + eb_b[ok_e])
+    cap.append(np.ones(int(ok_e.sum()), np.int64))
+    # broker -> t
+    src.append(o_brok + qb)
+    dst.append(np.full(qb.size, t, np.int64))
+    cap.append(quota[qb])
+
+    src = np.concatenate(src)
+    dst = np.concatenate(dst)
+    cap = np.concatenate(cap).astype(np.int32)
+    g = sp.csr_matrix((cap, (src, dst)), shape=(t + 1, t + 1))
+    res = maximum_flow(g, 0, t)
+    if res.flow_value != int(vac.sum()):
+        return None
+    flow = res.flow.tocoo()
+    out = []
+    for i, j, f in zip(flow.row, flow.col, flow.data):
+        if f > 0 and o_pair <= i < o_brok and o_brok <= j < t:
+            p = int(pk_p[i - o_pair])
+            b = int(j - o_brok)
+            out.extend([(p, b)] * int(f))
+    return out

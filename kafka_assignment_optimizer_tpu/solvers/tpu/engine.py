@@ -132,11 +132,22 @@ def solve_tpu(
     bounds_fut = _BoundsTask(
         lambda: (inst.move_lower_bound_exact(), inst.weight_upper_bound())
     )
+    # when balance bands bind, a second worker decodes the kept-replica
+    # LP into a plan (solvers.lp_round) — usually the certified global
+    # optimum, letting the solve skip annealing (and often compilation)
+    # entirely. Decommission-style instances skip this: their caps are
+    # slack, the annealer certifies on its own, and the LP would waste
+    # seconds of host CPU.
+    lp_fut = (
+        _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
+        if _caps_bind(inst)
+        else None
+    )
     return _solve_tpu_inner(
         inst, seed, batch, rounds, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
         platform, d, steps_per_round_ignored, t0, bounds_fut,
-        cert_min_savings_s,
+        cert_min_savings_s, lp_fut,
     )
 
 
@@ -145,6 +156,50 @@ def _budget_left(t0: float, time_limit_s: float | None) -> float | None:
     if time_limit_s is None:
         return None
     return max(0.0, t0 + time_limit_s - time.perf_counter())
+
+
+def _caps_bind(inst: ProblemInstance) -> bool:
+    """True when balance bands bind against the CURRENT assignment —
+    over-full or under-floor brokers for either replicas or leaderships.
+    These are exactly the instances where (a) local search must trade
+    keeps against bands and plateaus epsilon below the optimum, and (b)
+    the LP-rounding constructor (``solvers.lp_round``) tends to produce
+    a certified optimum outright: scale-outs, leader-skew rebalances,
+    RF changes. A plain decommission triggers neither side and keeps
+    its pure annealing fast path."""
+    B = inst.num_brokers
+    m_b = (inst.w_leader[:, :B] > 0).sum(axis=0)
+    lead = inst.a0[:, 0]
+    ok = (
+        (inst.rf > 0)
+        & (lead >= 0)
+        & (lead < B)
+        & (inst.w_leader[np.arange(inst.num_parts),
+                         np.clip(lead, 0, B - 1)] > 0)
+    )
+    lcnt = np.bincount(lead[ok], minlength=B)[:B]
+    return bool(
+        (m_b > inst.broker_hi).any()
+        or (m_b < inst.broker_lo).any()
+        or (lcnt > inst.leader_hi).any()
+        or (lcnt < inst.leader_lo).any()
+    )
+
+
+def _construct_worker(inst: ProblemInstance, bounds_fut) -> tuple:
+    """Bounds-thread body: decode the kept-replica LP into a plan and
+    certify it. Joins the main bounds prefetch first so the two workers
+    never duplicate the memoized bound computations."""
+    try:
+        bounds_fut.result()
+    except Exception:
+        pass
+    from ..lp_round import construct
+
+    plan = construct(inst)
+    if plan is None:
+        return None, False
+    return plan, inst.certify_optimal(plan)
 
 
 class _BoundsTask:
@@ -184,6 +239,7 @@ def _solve_tpu_inner(
     inst, seed, batch, rounds, steps_per_round, t_hi, t_lo, n_devices,
     engine, checkpoint, profile_dir, time_limit_s, platform, d,
     steps_per_round_ignored, t0, bounds_fut, cert_min_savings_s=1.0,
+    lp_fut=None,
 ) -> SolveResult:
     tight_fut = None
     # host-side greedy repair: near-feasible, near-min-move warm start
@@ -280,7 +336,40 @@ def _solve_tpu_inner(
     timed_out = False
     early_stopped = False
     certified_a = None
+    constructed = False
     rounds_run = 0
+
+    # LP-construct fast path (caps-bind instances): wait briefly for the
+    # constructor worker — a certified plan makes annealing, and on a
+    # cold process the 30s+ compile, unnecessary. If it is not done in
+    # time, annealing starts and the boundaries keep watching for it.
+    if lp_fut is not None:
+        budget = _budget_left(t0, time_limit_s)
+        try:
+            plan, ok = lp_fut.result(
+                timeout=5.0 if budget is None else min(5.0, budget)
+            )
+        except Exception:
+            plan, ok = None, False
+        if ok:
+            certified_a = np.asarray(plan, dtype=np.int32)
+            early_stopped = True
+            constructed = True
+        elif plan is not None:
+            # uncertified but complete: warm-start annealing from the
+            # LP structure when it outranks the greedy seed
+            plan = np.asarray(plan, dtype=np.int32)
+
+            def _rank(zz):
+                return (
+                    -sum(inst.violations(zz).values()),
+                    inst.preservation_weight(zz),
+                    -inst.move_count(zz),
+                )
+
+            if _rank(plan) > _rank(a_seed):
+                a_seed = plan
+
     seed_dev = jnp.asarray(a_seed, jnp.int32)
     curves = []
     pop_a = pop_k = None
@@ -289,9 +378,11 @@ def _solve_tpu_inner(
     # exactly the uncut ladder's trajectory
     sweep_state = (
         init_sweep_state(m, seed_dev, key, mesh, chains_per_device)
-        if engine == "sweep"
+        if engine == "sweep" and certified_a is None
         else None
     )
+    if certified_a is not None:
+        chunks = []
     with prof:
         deadline = None if time_limit_s is None else t0 + time_limit_s
         # chunk 0's duration is compile-inclusive and wildly overstates a
@@ -354,6 +445,18 @@ def _solve_tpu_inner(
             rounds_run += temps.shape[0]
             curves.append(np.asarray(jax.device_get(curve)))
             if i + 1 < len(chunks):
+                # a finished constructor worker short-circuits the rest
+                # of the ladder with its certified plan
+                if lp_fut is not None and lp_fut.done():
+                    try:
+                        plan, ok = lp_fut.result()
+                    except Exception:
+                        plan, ok = None, False
+                    if ok:
+                        certified_a = np.asarray(plan, dtype=np.int32)
+                        early_stopped = True
+                        constructed = True
+                        break
                 # boundary work: certify — if any per-shard winner
                 # provably hits the optimum, the remaining chunks cannot
                 # improve it. (The sweep engine's populations continue
@@ -429,7 +532,10 @@ def _solve_tpu_inner(
                 timed_out = i + 1 < len(chunks)
                 break
     t_solve = time.perf_counter()
-    curve = np.concatenate(curves, axis=1)
+    curve = (
+        np.concatenate(curves, axis=1) if curves
+        else np.zeros((1, 0), dtype=np.int64)
+    )
 
     if certified_a is not None:
         # a chunk-boundary candidate already carries the optimality
@@ -478,6 +584,31 @@ def _solve_tpu_inner(
             # below the weight bound: exact leader reseat (zero replica
             # movement) — weight-improving or a no-op
             best_a = inst.best_leader_assignment(best_a)
+        if lp_fut is not None:
+            # even an uncertified constructed plan may outrank the
+            # annealed one — compare under the solve's lexicographic
+            # objective (feasible, weight, fewest moves). Recompute the
+            # budget: the bounds join above may have consumed the last
+            # of it
+            budget = _budget_left(t0, time_limit_s)
+            try:
+                plan, _ok = lp_fut.result(
+                    timeout=10.0 if budget is None else budget
+                )
+            except Exception:
+                plan = None
+            if plan is not None:
+                def rank(zz):
+                    return (
+                        inst.is_feasible(zz),
+                        inst.preservation_weight(zz),
+                        -inst.move_count(zz),
+                    )
+
+                plan = np.asarray(plan, dtype=np.int32)
+                if rank(plan) > rank(best_a):
+                    best_a = plan
+                    constructed = True
         t_polish = time.perf_counter()
 
     # host-side exact verification (SURVEY.md §4.3 property): the engine's
@@ -541,6 +672,9 @@ def _solve_tpu_inner(
             "rounds_run": rounds_run,
             "timed_out": timed_out,
             "early_stopped": early_stopped,
+            # True when the plan came from the LP-rounding constructor
+            # (solvers.lp_round) rather than annealing
+            "constructed": constructed,
             # best known lower bound: the LP sharpening when it was
             # (lazily) evaluated, else the counting bound
             "moves_lb": (

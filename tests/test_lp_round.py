@@ -1,0 +1,95 @@
+"""LP-rounding constructor (``solvers/lp_round.py``): decoding the
+kept-replica LP vertex into a full plan must yield either None or a
+feasible plan, and a certified plan must equal the exact MILP optimum.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.api import optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+from kafka_assignment_optimizer_tpu.models.instance import build_instance
+from kafka_assignment_optimizer_tpu.solvers.lp_round import construct
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _inst(name):
+    sc = gen.SCENARIOS[name](**gen.SMOKE_KWARGS[name])
+    return sc, build_instance(
+        sc.current, sc.broker_list, sc.topology, target_rf=sc.target_rf
+    )
+
+
+@pytest.mark.parametrize(
+    "name", ["demo", "scale_out", "decommission", "leader_only",
+             "rf_change"]
+)
+def test_construct_is_exact_on_baseline_scenarios(name):
+    """On every BASELINE smoke scenario the constructor produces a
+    feasible plan matching the exact MILP optimum, with a certificate."""
+    sc, inst = _inst(name)
+    a = construct(inst)
+    assert a is not None
+    assert inst.is_feasible(a)
+    assert inst.certify_optimal(a)
+    exact = optimize(solver="milp", **sc.kwargs)
+    assert inst.preservation_weight(a) == exact.solve.objective
+    assert inst.move_count(a) <= exact.replica_moves
+
+
+def test_construct_never_infeasible_fuzz(rng):
+    """Random lopsided clusters: construct returns None or a feasible
+    plan — never a band-violating one."""
+    for trial in range(6):
+        n_b = int(rng.integers(6, 14))
+        n_p = int(rng.integers(8, 30))
+        rf = int(rng.integers(1, 3))
+        topo = Topology.from_dict(
+            {str(b): f"r{b % int(rng.integers(2, 4))}" for b in range(n_b)}
+        )
+        parts = []
+        for p in range(n_p):
+            reps = rng.choice(n_b, size=rf, replace=False).tolist()
+            parts.append(
+                PartitionAssignment(topic="t", partition=p, replicas=reps)
+            )
+        drop = int(rng.integers(0, n_b))
+        brokers = [b for b in range(n_b) if b != drop]
+        inst = build_instance(
+            Assignment(partitions=parts), brokers, topo
+        )
+        a = construct(inst)
+        if a is not None:
+            assert inst.is_feasible(a), (trial, inst.violations(a))
+
+
+def test_engine_uses_constructed_plan():
+    """optimize(solver='tpu') on a caps-bind scenario returns the
+    constructed certified plan without running any annealing rounds."""
+    sc, _ = _inst("scale_out")
+    r = optimize(solver="tpu", seed=0, **sc.kwargs)
+    s = r.solve.stats
+    assert s["constructed"]
+    assert s["proved_optimal"]
+    assert r.solve.optimal
+    assert s["rounds_run"] == 0
+    assert s["feasible"]
+
+
+def test_no_signal_keeps_annealing_path():
+    """A plain demo decommission has slack caps — no constructor worker
+    is launched and the annealer solves it (still to proven optimality)."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import _caps_bind
+
+    sc = gen.SCENARIOS["demo"]()
+    inst = build_instance(sc.current, sc.broker_list, sc.topology)
+    assert not _caps_bind(inst)
+    r = optimize(solver="tpu", seed=0, **sc.kwargs)
+    assert not r.solve.stats["constructed"]
+    assert r.solve.stats["proved_optimal"]
