@@ -544,7 +544,7 @@ class ProblemInstance:
         mrows, mcols = self._members()
         n = mrows.size
         if n == 0:
-            return (0, None) if return_solution else 0
+            return None if return_solution else 0
         try:
             B, K, P = self.num_brokers, self.num_racks, self.num_parts
             rack = self.rack_of_broker[mcols]
@@ -667,10 +667,9 @@ class ProblemInstance:
                 return None
             if return_solution:
                 sol = res.x
-                return None, {
+                return {
                     "x": sol[:n],
                     "y": sol[n:2 * n],
-                    "u": sol[u_off:u_off + B],
                     "z": sol[z_off:z_off + B],
                     "mrows": mrows,
                     "mcols": mcols,

@@ -38,12 +38,11 @@ from ..models.instance import ProblemInstance
 def construct(inst: ProblemInstance) -> np.ndarray | None:
     """Decode the kept-replica LP into a full plan, or None."""
     try:
-        out = inst._kept_weight_lp(return_solution=True)
+        sol = inst._kept_weight_lp(return_solution=True)
     except Exception:
         return None
-    if not isinstance(out, tuple) or out[1] is None:
+    if not isinstance(sol, dict):
         return None
-    _, sol = out
     x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
     z = np.asarray(sol["z"])
     mrows, mcols = sol["mrows"], sol["mcols"]

@@ -337,6 +337,7 @@ def _solve_tpu_inner(
     early_stopped = False
     certified_a = None
     constructed = False
+    reseat_tries = 0  # boundary leader-reseat attempts (bounded)
     rounds_run = 0
 
     # LP-construct fast path (caps-bind instances): wait briefly for the
@@ -497,11 +498,18 @@ def _solve_tpu_inner(
                         lb_exact, ub0 = bounds_fut.result()
                         if mc <= lb_exact:
                             w_cand = inst.preservation_weight(cand)
-                            if w_cand < ub0:
-                                # below the bound: reseat leaders
-                                # exactly (transportation LP) — leader
-                                # choice is the one axis annealing
-                                # leaves epsilon-suboptimal — and retest
+                            if w_cand < ub0 and (
+                                inst.total_replicas <= 60_000
+                                and reseat_tries < 3
+                            ):
+                                # below the bound: a leader reseat
+                                # (transportation LP) can lift it. The
+                                # LP costs seconds at scale (~7.5 s at
+                                # 150k slots), so boundaries never run
+                                # it on huge instances and at most 3
+                                # times elsewhere — the final
+                                # certification reseats once regardless
+                                reseat_tries += 1
                                 cand = inst.best_leader_assignment(cand)
                                 w_cand = inst.preservation_weight(cand)
                             if w_cand >= ub0:
