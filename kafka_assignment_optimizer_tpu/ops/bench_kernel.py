@@ -137,7 +137,38 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
         )
     except Exception as e:  # noqa: BLE001 - lowering failure IS the signal
         report["propose_error"] = repr(e)[:300]
-        return report
-    report["propose_pallas_s"] = round(pal_p, 5)
-    report["propose_speedup_vs_xla"] = round(xla_p / pal_p, 3)
+    else:
+        report["propose_pallas_s"] = round(pal_p, 5)
+        report["propose_speedup_vs_xla"] = round(xla_p / pal_p, 3)
+
+    # end-to-end sweep rate: the production stepper (8 chains, Mosaic
+    # kernels, snapshots, migration collectives) over a short ladder —
+    # the number that decides every solve's annealing wall-clock.
+    # Independent of the kernel results above (own try/except).
+    n_sweeps = 16
+    try:
+        from ..parallel.mesh import (
+            init_sweep_state,
+            make_mesh,
+            solve_on_mesh,
+        )
+        from ..solvers.tpu.arrays import geometric_temps
+
+        mesh = make_mesh(None)
+        temps = geometric_temps(2.0, 0.02, n_sweeps)
+        key = jax.random.PRNGKey(3)
+        state = init_sweep_state(m, a0, key, mesh, 8)
+
+        def run_ladder(st):
+            _st, pa, _pk, _c = solve_on_mesh(
+                m, a0, key, mesh, 8, n_sweeps, 1, engine="sweep",
+                temps=temps, scorer="pallas", state=st,
+            )
+            return pa
+
+        sweep_s = _timeit(run_ladder, state, reps=5)
+        report["sweep_ms"] = round(sweep_s / n_sweeps * 1000, 3)
+        report["sweeps_per_s"] = round(n_sweeps / sweep_s, 1)
+    except Exception as e:  # noqa: BLE001 - keep the rest of the report
+        report["sweep_error"] = repr(e)[:300]
     return report
