@@ -57,6 +57,18 @@ W_FOLLOWER_PROMOTE = 2  # current follower becomes leader
 W_FOLLOWER_KEEP = 1  # current follower stays follower
 
 
+
+def _safe_floor_ub(neg_fun: float) -> int:
+    """Floor an LP maximum into a still-valid integer upper bound.
+
+    The slack must dominate the solver's possible objective undershoot
+    (termination tolerances are RELATIVE, so a fixed absolute epsilon
+    fails at large objective scales); 1e-6 relative can at worst loosen
+    a razor-edge bound by 1, never tighten it below the true optimum."""
+    v = -neg_fun
+    return int(np.floor(v + 1e-6 * max(1.0, abs(v))))
+
+
 @dataclass
 class ProblemInstance:
     """Dense, index-based optimization model.
@@ -467,7 +479,7 @@ class ProblemInstance:
                          np.full(B, float(self.leader_hi))]
                     ),
                     bounds=(0, 1),
-                    method="highs",
+                    method="highs-ipm",
                     options={"time_limit": 30},
                 )
             else:
@@ -502,12 +514,12 @@ class ProblemInstance:
                     A_eq=sp.csr_matrix(np.ones((1, n + B))),
                     b_eq=np.array([float(p_active)]),
                     bounds=[(0, 1)] * n + [(0, float(p_active))] * B,
-                    method="highs",
+                    method="highs-ipm",
                     options={"time_limit": 30},
                 )
             if not res.success:
                 return None
-            return base + int(np.floor(-res.fun + 1e-7))
+            return base + _safe_floor_ub(res.fun)
         except Exception:
             return None
 
@@ -674,9 +686,9 @@ class ProblemInstance:
                     "mrows": mrows,
                     "mcols": mcols,
                 }
-            # floor-with-epsilon keeps the value a true upper bound on
-            # the integer optimum
-            return int(np.floor(-res.fun + 1e-7))
+            # relative-epsilon floor keeps the value a true upper bound
+            # on the integer optimum
+            return _safe_floor_ub(res.fun)
         except Exception:
             return None
 
