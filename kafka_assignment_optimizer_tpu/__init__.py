@@ -17,7 +17,7 @@ Layer map (mirrors SURVEY.md §1):
 - ``utils``   — reporting, RNG, checkpointing
 """
 
-from .api import optimize, OptimizeResult  # noqa: F401
+from .api import evaluate, optimize, OptimizeResult  # noqa: F401
 from .models.cluster import (  # noqa: F401
     Assignment,
     MoveReport,

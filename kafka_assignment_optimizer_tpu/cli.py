@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="PATH",
         help="also write the lp_solve LP-format equation file (README.md:144-185)",
     )
+    ap.add_argument(
+        "--evaluate",
+        metavar="PLAN.json",
+        help="audit an existing plan instead of solving: print its "
+        "feasibility, violation counts, moves vs the provable minimum, "
+        "and optimality verdict (e.g. score kafka-reassign-partitions "
+        "output, README.md:65-91)",
+    )
     return ap
 
 
@@ -112,6 +120,23 @@ def _run(args: argparse.Namespace) -> int:
     brokers = parse_broker_list(args.broker_list)
     all_ids = sorted(set(brokers) | set(current.broker_ids()))
     topology = load_topology(args.topology, all_ids)
+
+    if args.evaluate:
+        from .api import evaluate
+
+        rep = evaluate(
+            current,
+            brokers,
+            Path(args.evaluate).read_text(),
+            topology,
+            target_rf=args.rf,
+        )
+        out = json.dumps(rep, indent=args.indent, default=str)
+        if args.output:
+            Path(args.output).write_text(out + "\n")
+        else:
+            print(out)
+        return 0 if rep["feasible"] else 3
 
     kw: dict = {}
     if args.seed is not None:

@@ -155,6 +155,54 @@ class ProblemInstance:
         ]
         return Assignment(partitions=parts)
 
+    def encode(self, plan: Assignment) -> np.ndarray:
+        """Inverse of :meth:`decode`: map a plan in reassignment-JSON
+        form onto this instance's index space ``A[P, R]`` (slot 0 =
+        ``replicas[0]`` = leader). The plan must cover exactly this
+        instance's (topic, partition) set, with each replica list no
+        longer than the partition's target RF (the index space cannot
+        represent extra replicas, and silently truncating them would
+        let an over-replicated plan audit as feasible) — structural
+        mismatches raise. Everything representable is ENCODED rather
+        than judged: ineligible brokers map to the null bucket ``B``
+        (surfacing as ``null_in_valid_slot`` violations), duplicated
+        brokers land in their slots (``duplicate_in_partition``), and
+        short replica lists leave null slots — so external plans, e.g.
+        ``kafka-reassign-partitions`` output, get scored and certified
+        by the same oracle as every solver's."""
+        B = self.num_brokers
+        by_key = {
+            (p.topic, p.partition): p.replicas for p in plan.partitions
+        }
+        idx_of_broker = {int(b): i for i, b in enumerate(self.broker_ids)}
+        a = np.full((self.num_parts, self.max_rf), B, dtype=np.int32)
+        topic_names = [self.topics[t] for t in self.topic_of_part.tolist()]
+        pids = self.part_id.tolist()
+        rfs = self.rf.tolist()
+        seen = set()
+        for p in range(self.num_parts):
+            key = (topic_names[p], pids[p])
+            if key not in by_key:
+                raise ValueError(
+                    f"plan is missing partition {key[0]}/{key[1]}"
+                )
+            seen.add(key)
+            reps = by_key[key]
+            if len(reps) > rfs[p]:
+                raise ValueError(
+                    f"plan has {len(reps)} replicas for "
+                    f"{key[0]}/{key[1]} but the target RF is {rfs[p]} "
+                    "(pass target_rf / --rf to audit at a different RF)"
+                )
+            for s, broker in enumerate(reps):
+                a[p, s] = idx_of_broker.get(int(broker), B)
+        extra = set(by_key) - seen
+        if extra:
+            raise ValueError(
+                f"plan contains unknown partitions: {sorted(extra)[:3]}"
+            )
+        return a
+
     # -- feasibility / scoring (numpy reference; oracle for all backends) --
     def violations(self, a: np.ndarray) -> dict[str, int]:
         """Exact integer violation counts of the inequality families for a

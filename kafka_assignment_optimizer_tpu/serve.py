@@ -32,12 +32,22 @@ Endpoints:
     ``{"error": ...}``), 422 model rejected the inputs, 500 solver
     failure, 503 solver saturated past ``--lock-wait-s``.
 
+``POST /evaluate``
+    Audit an EXISTING plan (same fields as ``/submit`` minus
+    ``solver``/``options``, plus required ``plan``: a reassignment
+    JSON object). Response 200 is the audit report: feasibility with
+    per-constraint violation counts, replica moves vs the provable
+    minimum, objective weight vs its provable upper bound, and
+    ``proven_optimal``. Shares the solve lock (the bound computations
+    can cost seconds at 10k partitions) and sheds with 503 the same
+    way.
+
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
 
 ``GET /metrics``
-    Prometheus text counters: requests/solves/errors/sheds and solve
-    wall-clock totals (``kao_*``).
+    Prometheus text counters: requests/solves/evaluates/errors/sheds
+    and solve wall-clock totals (``kao_*``).
 
 Run: ``python -m kafka_assignment_optimizer_tpu.serve --port 8787``.
 """
@@ -82,8 +92,9 @@ DEFAULT_MAX_SOLVE_S = 300.0
 # their own lock so readers never contend with a solve
 _METRICS_LOCK = threading.Lock()
 _METRICS = {
-    "requests_total": 0,      # POST /submit received
+    "requests_total": 0,      # POST /submit or /evaluate received
     "solves_total": 0,        # solves completed successfully
+    "evaluates_total": 0,     # plan audits completed successfully
     "errors_total": 0,        # 4xx/5xx responses (excl. 503 sheds)
     "shed_total": 0,          # 503 saturation sheds
     "solve_seconds_total": 0.0,
@@ -227,6 +238,48 @@ def handle_submit(
     }
 
 
+def handle_evaluate(payload: dict, lock_wait_s: float) -> dict:
+    """POST /evaluate — audit an existing plan (``api.evaluate``):
+    feasibility, violation counts, moves vs the provable minimum, and
+    an optimality verdict. Same input fields as /submit plus the
+    required ``plan``. No solver runs, but the bound computations (LP,
+    max-flow) cost seconds at scale, so audits share the solve lock
+    and shed with 503 when saturated."""
+    if not isinstance(payload, dict):
+        raise ApiError(400, "payload must be a JSON object")
+    for field in ("assignment", "brokers", "plan"):
+        if field not in payload:
+            raise ApiError(400, f"missing required field '{field}'")
+    try:
+        current = Assignment.from_dict(payload["assignment"])
+        plan = Assignment.from_dict(payload["plan"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ApiError(400, f"bad assignment/plan: {e}") from e
+    brokers = _parse_brokers(payload["brokers"])
+    all_ids = sorted(set(brokers) | set(current.broker_ids()))
+    topology = _parse_topology(payload.get("topology"), all_ids)
+    rf = payload.get("rf")
+    if rf is not None and not isinstance(rf, (int, dict)):
+        raise ApiError(400, "'rf' must be an int, a topic->int object, or null")
+    from .api import evaluate
+
+    if not _SOLVE_LOCK.acquire(timeout=lock_wait_s):
+        _count(shed_total=1)
+        raise ApiError(
+            503,
+            f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
+        )
+    try:
+        out = evaluate(current, brokers, plan, topology, target_rf=rf)
+    except (ValueError, KeyError) as e:
+        msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
+        raise ApiError(422, f"model rejected inputs: {msg}") from e
+    finally:
+        _SOLVE_LOCK.release()
+    _count(evaluates_total=1)
+    return out
+
+
 def handle_healthz() -> dict:
     import jax
 
@@ -277,7 +330,8 @@ class Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no such endpoint: {self.path}"})
 
     def do_POST(self):
-        if self._route() != "/submit":
+        route = self._route()
+        if route not in ("/submit", "/evaluate"):
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
@@ -294,6 +348,13 @@ class Handler(BaseHTTPRequestHandler):
                 payload = json.loads(raw)
             except json.JSONDecodeError as e:
                 raise ApiError(400, f"invalid JSON: {e}") from e
+            if route == "/evaluate":
+                self._send(200, handle_evaluate(
+                    payload,
+                    lock_wait_s=getattr(self.server, "lock_wait_s",
+                                        DEFAULT_LOCK_WAIT_S),
+                ))
+                return
             self._send(200, handle_submit(
                 payload,
                 lock_wait_s=getattr(self.server, "lock_wait_s",

@@ -24,17 +24,7 @@ def server_url():
 
 
 def post(url, payload):
-    req = urllib.request.Request(
-        url + "/submit",
-        data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=120) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+    return post_to(url, "/submit", payload)
 
 
 def test_submit_demo_golden(server_url):
@@ -221,3 +211,54 @@ def test_metrics_endpoint(server_url):
     assert status == 400
     final = scrape()
     assert final["kao_errors_total"] == after["kao_errors_total"] + 1
+
+
+def post_to(url, path, payload):
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_evaluate_endpoint_audits_plans(server_url):
+    """POST /evaluate: certify the optimal plan, flag a stale one."""
+    status, body = post(server_url, {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "milp",
+    })
+    assert status == 200, body
+    status, rep = post_to(server_url, "/evaluate", {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "plan": body["assignment"],
+    })
+    assert status == 200, rep
+    assert rep["feasible"] and rep["proven_optimal"]
+    assert rep["replica_moves"] == 1 == rep["min_moves_lower_bound"]
+
+    # the unmodified current assignment references removed broker 19
+    status, rep = post_to(server_url, "/evaluate", {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "plan": demo_assignment().to_dict(),
+    })
+    assert status == 200
+    assert not rep["feasible"] and not rep["proven_optimal"]
+
+    # missing plan field
+    status, rep = post_to(server_url, "/evaluate", {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+    })
+    assert status == 400
