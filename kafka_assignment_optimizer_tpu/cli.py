@@ -41,7 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="broker->rack map: JSON file, inline JSON, or 'even-odd' "
         "(the reference demo topology, README.md:27-29). Default: one rack.",
     )
-    ap.add_argument("--rf", type=int, help="target replication factor (RF change)")
+    ap.add_argument(
+        "--rf",
+        help="target replication factor (RF change): an int for all "
+        "topics, or an inline/file JSON object mapping topic -> RF "
+        '(e.g. \'{"logs": 3}\'; unlisted topics keep their current RF)',
+    )
     ap.add_argument(
         "--solver",
         default="auto",
@@ -88,6 +93,32 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def parse_rf(spec: str | None) -> int | dict | None:
+    """``--rf``: an int, inline JSON object, or a JSON file path."""
+    if spec is None:
+        return None
+    try:
+        return int(spec)
+    except ValueError:
+        pass
+    p = Path(spec)
+    text = p.read_text() if p.exists() else spec
+    try:
+        rf = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"--rf {spec!r} is neither an int, an existing JSON file, "
+            f"nor valid inline JSON ({e})"
+        ) from e
+    if not isinstance(rf, dict) or not all(
+        isinstance(v, int) and not isinstance(v, bool) for v in rf.values()
+    ):
+        raise ValueError(
+            "--rf must be an int or a topic->int JSON object"
+        )
+    return rf
+
+
 def load_topology(spec: str | None, broker_ids: list[int]) -> Topology | None:
     if spec is None:
         return None
@@ -117,6 +148,7 @@ def main(argv: list[str] | None = None) -> int:
 def _run(args: argparse.Namespace) -> int:
     text = Path(args.input).read_text() if args.input else sys.stdin.read()
     current = Assignment.from_json(text)
+    target_rf = parse_rf(args.rf)
     brokers = parse_broker_list(args.broker_list)
     all_ids = sorted(set(brokers) | set(current.broker_ids()))
     topology = load_topology(args.topology, all_ids)
@@ -129,7 +161,7 @@ def _run(args: argparse.Namespace) -> int:
             brokers,
             Path(args.evaluate).read_text(),
             topology,
-            target_rf=args.rf,
+            target_rf=target_rf,
         )
         out = json.dumps(rep, indent=args.indent, default=str)
         if args.output:
@@ -158,7 +190,7 @@ def _run(args: argparse.Namespace) -> int:
         current,
         brokers,
         topology,
-        target_rf=args.rf,
+        target_rf=target_rf,
         solver=args.solver,
         **kw,
     )

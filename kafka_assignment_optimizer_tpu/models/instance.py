@@ -941,6 +941,16 @@ def build_instance(
             topics.append(p.topic)
     P = len(parts)
 
+    if isinstance(target_rf, dict):
+        # a typo'd topic would otherwise be silently ignored and the
+        # operator would apply a plan believing RF was raised
+        unknown = sorted(set(target_rf) - set(topic_idx))
+        if unknown:
+            raise ValueError(
+                f"target_rf names unknown topic(s) {unknown}; "
+                f"assignment has {sorted(topic_idx)}"
+            )
+
     def rf_for(p: PartitionAssignment) -> int:
         if target_rf is None:
             return len(p.replicas)

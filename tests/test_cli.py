@@ -44,3 +44,57 @@ def test_cli_demo_golden(tmp_path):
 def test_cli_infeasible_inputs_error():
     proc = run_cli(["--broker-list", "0"], demo_assignment().to_json())
     assert proc.returncode != 0
+
+
+def test_cli_per_topic_rf():
+    """--rf accepts a topic->RF JSON object: only the listed topic
+    grows, others keep their current RF."""
+    current = {
+        "version": 1,
+        "partitions": [
+            {"topic": "logs", "partition": 0, "replicas": [0, 1]},
+            {"topic": "logs", "partition": 1, "replicas": [2, 3]},
+            {"topic": "metrics", "partition": 0, "replicas": [4, 5]},
+        ],
+    }
+    proc = run_cli(
+        ["--broker-list", "0-7", "--solver", "milp",
+         "--rf", '{"logs": 3}'],
+        json.dumps(current),
+    )
+    assert proc.returncode == 0, proc.stderr
+    plan = json.loads(proc.stdout)
+    by_key = {(p["topic"], p["partition"]): p["replicas"]
+              for p in plan["partitions"]}
+    assert len(by_key[("logs", 0)]) == 3
+    assert len(by_key[("logs", 1)]) == 3
+    assert len(by_key[("metrics", 0)]) == 2
+
+    # malformed --rf -> clean error, exit 2
+    proc = run_cli(
+        ["--broker-list", "0-7", "--rf", '{"logs": "three"}'],
+        json.dumps(current),
+    )
+    assert proc.returncode == 2
+    assert "topic->int" in proc.stderr
+
+
+def test_cli_rf_error_paths():
+    current = {
+        "version": 1,
+        "partitions": [{"topic": "logs", "partition": 0, "replicas": [0, 1]}],
+    }
+    # typo'd topic must fail loudly, not silently no-op
+    proc = run_cli(
+        ["--broker-list", "0-7", "--rf", '{"lgs": 3}'],
+        json.dumps(current),
+    )
+    assert proc.returncode == 2
+    assert "unknown topic" in proc.stderr
+    # a mistyped file path must name --rf in the error
+    proc = run_cli(
+        ["--broker-list", "0-7", "--rf", "rf.jsonn"],
+        json.dumps(current),
+    )
+    assert proc.returncode == 2
+    assert "--rf" in proc.stderr
