@@ -93,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _spec_text(spec: str) -> str:
+    """Resolve a flag value that may be a file path or inline text."""
+    p = Path(spec)
+    return p.read_text() if p.exists() else spec
+
+
 def parse_rf(spec: str | None) -> int | dict | None:
     """``--rf``: an int, inline JSON object, or a JSON file path."""
     if spec is None:
@@ -101,10 +107,8 @@ def parse_rf(spec: str | None) -> int | dict | None:
         return int(spec)
     except ValueError:
         pass
-    p = Path(spec)
-    text = p.read_text() if p.exists() else spec
     try:
-        rf = json.loads(text)
+        rf = json.loads(_spec_text(spec))
     except json.JSONDecodeError as e:
         raise ValueError(
             f"--rf {spec!r} is neither an int, an existing JSON file, "
@@ -124,10 +128,7 @@ def load_topology(spec: str | None, broker_ids: list[int]) -> Topology | None:
         return None
     if spec == "even-odd":
         return Topology.even_odd(broker_ids)
-    p = Path(spec)
-    if p.exists():
-        return Topology.from_json(p.read_text())
-    return Topology.from_json(spec)
+    return Topology.from_json(_spec_text(spec))
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -104,6 +104,9 @@ def solve_tpu(
     enable_compile_cache()
     platform = ensure_backend()
     t_backend = time.perf_counter()  # TPU client init can cost seconds
+    # pre-default arguments: the fallback retry must forward what the
+    # USER asked for, not this engine's resolved defaults
+    engine_arg, batch_arg, t_hi_arg, t_lo_arg = engine, batch, t_hi, t_lo
     d = _defaults(inst, platform, engine)
     engine = d["engine"]
     batch = batch or d["batch"]
@@ -144,12 +147,56 @@ def solve_tpu(
         if _caps_bind(inst)
         else None
     )
-    return _solve_tpu_inner(
+    res = _solve_tpu_inner(
         inst, seed, batch, rounds, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
         platform, d, steps_per_round_ignored, t0, bounds_fut,
         cert_min_savings_s, lp_fut, t_backend,
     )
+    # robustness net: on TPU the sweep engine is the default at every
+    # size, but ultra-tight small instances (exact rack bands + strict
+    # per-partition diversity at high RF) can defeat its conflict-
+    # thinned parallel moves while the sequential chain engine closes
+    # them. When a DEFAULTED sweep ends infeasible on an instance small
+    # enough for chains, retry with the chain engine and keep the
+    # better-ranked plan.
+    if (
+        not res.stats["feasible"]
+        and engine_arg is None
+        and res.stats["engine"] == "sweep"
+        and inst.num_parts < _SWEEP_THRESHOLD_PARTS
+        and (time_limit_s is None
+             or _budget_left(t0, time_limit_s) > 0)
+    ):
+        remaining = (
+            None if time_limit_s is None
+            else _budget_left(t0, time_limit_s)
+        )
+        # engine-neutral knobs carry over; the budget knobs
+        # (rounds/sweeps/steps_per_round) deliberately do NOT — each
+        # engine's budget is meaningless for the other (see _defaults),
+        # so the retry runs the chain engine's own defaults
+        res2 = solve_tpu(
+            inst, seed=seed, engine="chain", n_devices=n_devices,
+            batch=batch_arg, t_hi=t_hi_arg, t_lo=t_lo_arg,
+            checkpoint=checkpoint, profile_dir=profile_dir,
+            time_limit_s=remaining,
+            cert_min_savings_s=cert_min_savings_s,
+        )
+        def rank(r):
+            return (
+                r.stats["feasible"],
+                -r.stats["violations"],
+                r.objective,
+                -r.stats["moves"],
+            )
+
+        if rank(res2) > rank(res):
+            res2.stats["engine_fallback"] = (
+                "chain after infeasible defaulted sweep"
+            )
+            return res2
+    return res
 
 
 def _budget_left(t0: float, time_limit_s: float | None) -> float | None:
