@@ -100,6 +100,7 @@ def evaluate(
     plan: Assignment | str | dict,
     topology: Topology | dict | None = None,
     target_rf: int | dict | None = None,
+    time_budget_s: float | None = None,
 ) -> dict:
     """Audit an EXISTING plan — ours, another tool's, or
     ``kafka-reassign-partitions`` output — against the same model and
@@ -122,6 +123,11 @@ def evaluate(
         topology = Topology.from_dict(topology)
 
     inst = build_instance(current, broker_list, topology, target_rf)
+    if time_budget_s is not None:
+        # cap the audit's bound LPs (level-0/1/2 + certification) at the
+        # caller's wall budget; expired tiers fall back to cheaper
+        # bounds — looser verdicts, never a blown deadline
+        inst.set_bounds_deadline(time_budget_s)
     a = inst.encode(plan)
     viol = inst.violations(a)
     feasible = all(v == 0 for v in viol.values())

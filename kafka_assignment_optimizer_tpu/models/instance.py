@@ -43,6 +43,7 @@ and the demo's 1-move optimum (golden test).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -67,6 +68,50 @@ def _safe_floor_ub(neg_fun: float) -> int:
     a razor-edge bound by 1, never tighten it below the true optimum."""
     v = -neg_fun
     return int(np.floor(v + 1e-6 * max(1.0, abs(v))))
+
+
+def _dual_repair_max_ub(c, a_ub, b_ub, a_eq, b_eq, lo, hi, res):
+    """Certified upper bound on ``max -c'x`` from an (approximate) LP
+    solve, via dual-feasibility repair — sound even when the primal
+    iterate undershoots the true optimum (e.g. ``highs-ipm`` without
+    crossover, whose termination tolerance is all that protects the
+    primal value).
+
+    Takes the solver's constraint marginals as a *starting point* for
+    the dual (lam = -ineq marginals clamped >= 0, mu = -eq marginals),
+    then restores exact dual stationarity by absorbing the residual
+    ``r = c + A_ub' lam + A_eq' mu`` into the variable-bound duals
+    (alpha = max(r, 0) on x >= lo, beta = max(-r, 0) on x <= hi). Any
+    such (lam, mu, alpha, beta) is dual feasible, so by weak duality
+
+        min c'x  >=  -lam'b_ub - mu'b_eq + alpha'lo - beta'hi
+
+    and ``max -c'x <= -that``. Returns the float bound, or None when
+    the solve carried no marginals (then the caller falls back to the
+    primal value, which is exact for simplex/crossover methods)."""
+    try:
+        m_ub = getattr(res.ineqlin, "marginals", None)
+        m_eq = getattr(res.eqlin, "marginals", None)
+        if m_ub is None or m_eq is None:
+            return None
+        lam = np.maximum(-np.asarray(m_ub, dtype=np.float64), 0.0)
+        mu = -np.asarray(m_eq, dtype=np.float64)
+        r = np.asarray(c, dtype=np.float64)
+        if lam.size:
+            r = r + a_ub.T @ lam
+        if mu.size:
+            r = r + a_eq.T @ mu
+        alpha = np.maximum(r, 0.0)
+        beta = np.maximum(-r, 0.0)
+        dual = (
+            -(lam @ b_ub if lam.size else 0.0)
+            - (mu @ b_eq if mu.size else 0.0)
+            + alpha @ lo
+            - beta @ hi
+        )
+        return float(-dual)
+    except Exception:
+        return None
 
 
 @dataclass
@@ -171,9 +216,18 @@ class ProblemInstance:
         ``kafka-reassign-partitions`` output, get scored and certified
         by the same oracle as every solver's."""
         B = self.num_brokers
-        by_key = {
-            (p.topic, p.partition): p.replicas for p in plan.partitions
-        }
+        by_key: dict[tuple[str, int], list[int]] = {}
+        for p in plan.partitions:
+            key = (p.topic, p.partition)
+            if key in by_key:
+                # last-wins dict building would silently dedupe a
+                # malformed plan listing the same partition twice (with
+                # possibly conflicting replica lists) — a structural
+                # mismatch, so it raises like the others
+                raise ValueError(
+                    f"plan lists partition {key[0]}/{key[1]} more than once"
+                )
+            by_key[key] = p.replicas
         idx_of_broker = {int(b): i for i, b in enumerate(self.broker_ids)}
         a = np.full((self.num_parts, self.max_rf), B, dtype=np.int32)
         topic_names = [self.topics[t] for t in self.topic_of_part.tolist()]
@@ -383,6 +437,29 @@ class ProblemInstance:
             memo[2] = memo[1] if kept is None else min(memo[1], kept)
         return memo[level]
 
+    def set_bounds_deadline(self, budget_s: float | None) -> None:
+        """Give the bound LPs a wall-clock budget: each subsequent LP
+        gets ``min(30 s, time remaining)`` as its HiGHS time limit, and
+        LPs starting after the deadline are skipped outright (the bound
+        ladder then falls back to the cheapest computed level — looser,
+        never unsound). Used by deadline-sensitive callers: the serve
+        audit endpoint (``--max-solve-s``) and the engine's bounds
+        worker."""
+        self._bounds_deadline = (
+            None if budget_s is None else time.perf_counter() + budget_s
+        )
+
+    def _lp_options(self, default_limit: float = 30.0) -> dict | None:
+        """HiGHS options for one bound LP under the instance deadline;
+        None when the deadline has already passed (caller skips)."""
+        d = getattr(self, "_bounds_deadline", None)
+        if d is None:
+            return {"time_limit": default_limit}
+        remaining = d - time.perf_counter()
+        if remaining <= 0.05:
+            return None
+        return {"time_limit": min(default_limit, remaining)}
+
     def best_known_weight_ub(self) -> int | None:
         """The tightest weight upper bound evaluated so far (for
         reports), or None if none has been."""
@@ -514,21 +591,25 @@ class ProblemInstance:
             b_of = ids[rows, cols]
             n = rows.size
             var = np.arange(n)
+            opts = self._lp_options()
+            if opts is None:  # bounds deadline already spent
+                return None
             per_part = sp.csr_matrix(  # one leading member each
                 (np.ones(n), (rows, var)), shape=(self.num_parts, n)
             )
             cap = sp.csr_matrix((np.ones(n), (b_of, var)), shape=(B, n))
             if not with_lower:
+                c = -g
+                a_ub = sp.vstack([per_part, cap], format="csr")
+                b_ub = np.concatenate(
+                    [np.ones(self.num_parts),
+                     np.full(B, float(self.leader_hi))]
+                )
+                a_eq, b_eq = None, None
+                lo, hi = np.zeros(n), np.ones(n)
                 res = linprog(
-                    -g,
-                    A_ub=sp.vstack([per_part, cap], format="csr"),
-                    b_ub=np.concatenate(
-                        [np.ones(self.num_parts),
-                         np.full(B, float(self.leader_hi))]
-                    ),
-                    bounds=(0, 1),
-                    method="highs-ipm",
-                    options={"time_limit": 30},
+                    c, A_ub=a_ub, b_ub=b_ub, bounds=(0, 1),
+                    method="highs-ipm", options=opts,
                 )
             else:
                 # columns: x (gainful member leads) then y (per-broker
@@ -555,19 +636,33 @@ class ProblemInstance:
                         np.full(B, -float(self.leader_lo)),
                     ]
                 )
+                c = -np.concatenate([g, np.zeros(B)])
+                # every live partition has exactly one leader
+                a_eq = sp.csr_matrix(np.ones((1, n + B)))
+                b_eq = np.array([float(p_active)])
+                lo = np.zeros(n + B)
+                hi = np.concatenate(
+                    [np.ones(n), np.full(B, float(p_active))]
+                )
                 res = linprog(
-                    -np.concatenate([g, np.zeros(B)]),
-                    A_ub=a_ub, b_ub=b_ub,
-                    # every live partition has exactly one leader
-                    A_eq=sp.csr_matrix(np.ones((1, n + B))),
-                    b_eq=np.array([float(p_active)]),
+                    c, A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
                     bounds=[(0, 1)] * n + [(0, float(p_active))] * B,
-                    method="highs-ipm",
-                    options={"time_limit": 30},
+                    method="highs-ipm", options=opts,
                 )
             if not res.success:
                 return None
-            return base + _safe_floor_ub(res.fun)
+            # certificate-critical: the repaired dual bound is valid
+            # regardless of primal tolerance, so when marginals exist it
+            # is the ONLY sound choice — a loose repair weakens the
+            # verdict, never the soundness. The max with the primal
+            # value guards fp noise in the repair arithmetic (a feasible
+            # iterate's value never exceeds the true optimum, so the max
+            # is still an upper bound). Primal fallback only when the
+            # solve carried no marginals at all.
+            ub = _dual_repair_max_ub(c, a_ub, b_ub, a_eq, b_eq, lo, hi, res)
+            if ub is None:
+                return base + _safe_floor_ub(res.fun)
+            return base + _safe_floor_ub(-max(ub, -res.fun))
         except Exception:
             return None
 
@@ -605,6 +700,12 @@ class ProblemInstance:
         n = mrows.size
         if n == 0:
             return None if return_solution else 0
+        # deadline check BEFORE model build: assembling the sparse
+        # matrices costs seconds at 10k partitions (and holds the serve
+        # solve lock) — an expired budget must not pay it
+        opts = self._lp_options()
+        if opts is None:
+            return None
         try:
             B, K, P = self.num_brokers, self.num_racks, self.num_parts
             rack = self.rack_of_broker[mcols]
@@ -721,7 +822,7 @@ class ProblemInstance:
                 c,
                 A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
                 bounds=bounds, method="highs",
-                options={"time_limit": 30},
+                options=opts,
             )
             if not res.success:
                 return None
@@ -734,9 +835,15 @@ class ProblemInstance:
                     "mrows": mrows,
                     "mcols": mcols,
                 }
-            # relative-epsilon floor keeps the value a true upper bound
-            # on the integer optimum
-            return _safe_floor_ub(res.fun)
+            # certificate-critical: when marginals exist the repaired
+            # dual bound is the only sound choice (see _leader_cap_lp);
+            # max with the primal value guards repair fp noise
+            lo = np.array([b[0] for b in bounds], dtype=np.float64)
+            hi = np.array([b[1] for b in bounds], dtype=np.float64)
+            ub = _dual_repair_max_ub(c, a_ub, b_ub, a_eq, b_eq, lo, hi, res)
+            if ub is None:
+                return _safe_floor_ub(res.fun)
+            return _safe_floor_ub(-max(ub, -res.fun))
         except Exception:
             return None
 

@@ -42,6 +42,15 @@ Endpoints:
     can cost seconds at 10k partitions) and sheds with 503 the same
     way.
 
+``GET /``
+    Human-usable front door (the reference hosts a public instance
+    with a usage/extended-example page, ``README.md:189-195``): HTML
+    usage + a live form prefilled with the reference demo. Clients
+    sending ``Accept: application/json`` get the request schema.
+
+``GET /schema``
+    Machine-readable request/response shapes (JSON).
+
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
 
@@ -61,6 +70,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import landing
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
 
@@ -238,13 +248,16 @@ def handle_submit(
     }
 
 
-def handle_evaluate(payload: dict, lock_wait_s: float) -> dict:
+def handle_evaluate(payload: dict, lock_wait_s: float,
+                    max_solve_s: float | None = DEFAULT_MAX_SOLVE_S) -> dict:
     """POST /evaluate — audit an existing plan (``api.evaluate``):
     feasibility, violation counts, moves vs the provable minimum, and
     an optimality verdict. Same input fields as /submit plus the
     required ``plan``. No solver runs, but the bound computations (LP,
-    max-flow) cost seconds at scale, so audits share the solve lock
-    and shed with 503 when saturated."""
+    max-flow) cost seconds at scale, so audits share the solve lock,
+    shed with 503 when saturated, and cap their bound LPs at the same
+    ``--max-solve-s`` budget as solves (expired tiers degrade to
+    cheaper bounds rather than hold the lock)."""
     if not isinstance(payload, dict):
         raise ApiError(400, "payload must be a JSON object")
     for field in ("assignment", "brokers", "plan"):
@@ -270,7 +283,8 @@ def handle_evaluate(payload: dict, lock_wait_s: float) -> dict:
             f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
         )
     try:
-        out = evaluate(current, brokers, plan, topology, target_rf=rf)
+        out = evaluate(current, brokers, plan, topology, target_rf=rf,
+                       time_budget_s=max_solve_s)
     except (ValueError, KeyError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
         raise ApiError(422, f"model rejected inputs: {msg}") from e
@@ -315,7 +329,22 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         route = self._route()
-        if route in ("/", "/healthz"):
+        if route == "/":
+            # the human-usable front door (reference hosted-instance UX,
+            # README.md:189-195); JSON clients negotiate the schema
+            accept = self.headers.get("Accept", "")
+            if "application/json" in accept and "text/html" not in accept:
+                self._send(200, landing.request_schema())
+                return
+            body = landing.render_landing().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif route == "/schema":
+            self._send(200, landing.request_schema())
+        elif route == "/healthz":
             self._send(200, handle_healthz())
         elif route == "/metrics":
             body = render_metrics().encode()
@@ -353,6 +382,8 @@ class Handler(BaseHTTPRequestHandler):
                     payload,
                     lock_wait_s=getattr(self.server, "lock_wait_s",
                                         DEFAULT_LOCK_WAIT_S),
+                    max_solve_s=getattr(self.server, "max_solve_s",
+                                        DEFAULT_MAX_SOLVE_S),
                 ))
                 return
             self._send(200, handle_submit(

@@ -130,3 +130,40 @@ def test_cli_evaluate_roundtrip(tmp_path, demo_solved):
     )
     assert r.returncode == 3, r.stderr
     assert not json.loads(r.stdout)["feasible"]
+
+
+def test_evaluate_rejects_duplicated_partition_in_plan(demo_solved):
+    """A plan listing the same (topic, partition) twice — possibly with
+    conflicting replica lists — is a structural mismatch, not something
+    to silently dedupe last-wins (ADVICE r2)."""
+    plan = json.loads(demo_solved.assignment.to_json())
+    dup = dict(plan["partitions"][1])
+    dup["replicas"] = list(reversed(dup["replicas"]))
+    plan["partitions"].append(dup)
+    with pytest.raises(ValueError, match="more than once"):
+        evaluate(
+            demo_assignment(), demo_broker_list(), plan, demo_topology()
+        )
+
+
+def test_evaluate_time_budget_degrades_not_blocks(demo_solved):
+    """An (absurdly) tight time budget must not crash or hang the audit:
+    expired bound tiers degrade to cheaper bounds; feasibility and the
+    move diff are still exact."""
+    import time
+
+    t0 = time.perf_counter()
+    rep = evaluate(
+        demo_assignment(), demo_broker_list(),
+        demo_solved.assignment, demo_topology(),
+        time_budget_s=1e-9,
+    )
+    assert time.perf_counter() - t0 < 30
+    assert rep["feasible"] and rep["replica_moves"] == 1
+    # with a real budget the audit certifies as before
+    rep = evaluate(
+        demo_assignment(), demo_broker_list(),
+        demo_solved.assignment, demo_topology(),
+        time_budget_s=60,
+    )
+    assert rep["proven_optimal"]

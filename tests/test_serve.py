@@ -262,3 +262,34 @@ def test_evaluate_endpoint_audits_plans(server_url):
         "brokers": "0-18",
     })
     assert status == 400
+
+
+def test_landing_page_front_door(server_url):
+    """GET /: the human-usable landing page (reference hosted-instance
+    UX, README.md:189-195) — HTML with the worked example, the live
+    form, and links to the machine surfaces."""
+    with urllib.request.urlopen(server_url + "/", timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/html")
+        html = resp.read().decode()
+    assert "POST /submit" in html and "/evaluate" in html
+    assert "x.y.z.t" in html  # prefilled demo assignment
+    for link in ("/healthz", "/metrics", "/schema"):
+        assert link in html
+
+
+def test_landing_content_negotiation_and_schema(server_url):
+    """JSON clients on / get the schema; GET /schema always does."""
+    req = urllib.request.Request(
+        server_url + "/", headers={"Accept": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert "POST /submit" in body["endpoints"]
+    with urllib.request.urlopen(server_url + "/schema", timeout=30) as resp:
+        schema = json.loads(resp.read())
+    assert schema["endpoints"] == body["endpoints"]
+    # the embedded example is itself a valid /submit payload
+    ex = schema["example"]
+    status, out = post(server_url, dict(ex, solver="milp"))
+    assert status == 200 and out["report"]["replica_moves"] == 1
