@@ -43,11 +43,16 @@ and the demo's 1-move optimum (golden test).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Sequence
 
 import numpy as np
+
+# guards creation of per-instance memo locks (instances are dataclasses;
+# the lock attribute is created lazily on first bound computation)
+_MEMO_GUARD = threading.Lock()
 
 from .cluster import Assignment, PartitionAssignment, Topology
 
@@ -414,28 +419,62 @@ class ProblemInstance:
           over-full (scale-out). Seconds at 10k partitions, so only on
           explicit request (the engine runs it on a worker thread).
 
-        ``certify_optimal`` escalates 0 -> 1 -> 2."""
+        ``certify_optimal`` escalates 0 -> 1 -> 2.
+
+        Thread-safe: the tier ladder runs under a per-instance lock
+        (the engine prefetches bounds on worker threads while the main
+        thread certifies — without the lock both would solve the same
+        multi-second LPs). A caller that no longer needs tighter tiers
+        (a finished solve with straggling workers) sets
+        ``_bounds_cancelled``; not-yet-memoized tiers are then skipped
+        WITHOUT memoizing, so the cancellation can never poison a later
+        legitimate escalation."""
         level = 2 if tight else level
-        memo = getattr(self, "_wub_memo", None)
-        if memo is None:
-            memo = {}
-            self._wub_memo = memo
-        if 0 not in memo:
-            lead = self._leader_cap_lp(with_lower=False)
-            mw = self.max_weight()
-            memo[0] = mw if lead is None else min(mw, lead)
-        # LP cost grows superlinearly in member count; past ~60k members
-        # (20k partitions at RF=3) the higher levels stick with the
-        # cheaper bound rather than stall a certificate check for tens
-        # of seconds (a HiGHS time_limit bounds them regardless)
-        big = level >= 1 and self._members()[0].size > 60_000
-        if level >= 1 and 1 not in memo:
-            lead = None if big else self._leader_cap_lp(with_lower=True)
-            memo[1] = memo[0] if lead is None else min(memo[0], lead)
-        if level >= 2 and 2 not in memo:
-            kept = None if big else self._kept_weight_lp()
-            memo[2] = memo[1] if kept is None else min(memo[1], kept)
-        return memo[level]
+        with self._memo_lock():
+            memo = getattr(self, "_wub_memo", None)
+            if memo is None:
+                memo = {}
+                self._wub_memo = memo
+            if 0 not in memo:
+                lead = self._leader_cap_lp(with_lower=False)
+                mw = self.max_weight()
+                memo[0] = mw if lead is None else min(mw, lead)
+            # LP cost grows superlinearly in member count; past ~60k
+            # members (20k partitions at RF=3) the higher levels stick
+            # with the cheaper bound rather than stall a certificate
+            # check for tens of seconds (a HiGHS time_limit bounds them
+            # regardless)
+            big = level >= 1 and self._members()[0].size > 60_000
+            if level >= 1 and 1 not in memo:
+                if getattr(self, "_bounds_cancelled", False):
+                    return memo[0]
+                lead = None if big else self._leader_cap_lp(with_lower=True)
+                memo[1] = memo[0] if lead is None else min(memo[0], lead)
+            if level >= 2 and 2 not in memo:
+                if getattr(self, "_bounds_cancelled", False):
+                    return memo[1]
+                kept = None if big else self._kept_weight_lp()
+                memo[2] = memo[1] if kept is None else min(memo[1], kept)
+            return memo[level]
+
+    def _memo_lock(self) -> threading.Lock:
+        lock = getattr(self, "_bounds_memo_lock", None)
+        if lock is None:
+            with _MEMO_GUARD:
+                lock = getattr(self, "_bounds_memo_lock", None)
+                if lock is None:
+                    lock = threading.Lock()
+                    self._bounds_memo_lock = lock
+        return lock
+
+    def cancel_pending_bounds(self) -> None:
+        """Tell straggling bound workers to stop escalating: tiers not
+        yet memoized are skipped (un-memoized) on the next check. The
+        in-flight HiGHS solve still runs to its time limit — scipy
+        cannot be interrupted — but no NEW tier starts. Engines call
+        this when their solve returns so a daemon bounds thread cannot
+        grind multi-second LPs into the next request's wall-clock."""
+        self._bounds_cancelled = True
 
     def set_bounds_deadline(self, budget_s: float | None) -> None:
         """Give the bound LPs a wall-clock budget: each subsequent LP

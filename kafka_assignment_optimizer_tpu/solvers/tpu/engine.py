@@ -101,6 +101,9 @@ def solve_tpu(
     t0 = time.perf_counter()
     from ...utils.platform import enable_compile_cache, ensure_backend
 
+    # a previous solve on this instance may have cancelled straggling
+    # bound workers at its return; this solve gets a fresh escalation
+    inst._bounds_cancelled = False
     enable_compile_cache()
     platform = ensure_backend()
     t_backend = time.perf_counter()  # TPU client init can cost seconds
@@ -195,7 +198,13 @@ def solve_tpu(
             res2.stats["engine_fallback"] = (
                 "chain after infeasible defaulted sweep"
             )
-            return res2
+            res = res2
+    # the solve is over: straggling bounds workers (tier-1/2 LPs on
+    # daemon threads) must not escalate further and grind host CPU into
+    # the next request's wall-clock (ADVICE r2). The flag skips
+    # not-yet-started tiers only; post-solve audits use evaluate(),
+    # which builds its own instance.
+    inst.cancel_pending_bounds()
     return res
 
 
