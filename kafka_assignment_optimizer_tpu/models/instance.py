@@ -54,6 +54,12 @@ import numpy as np
 # the lock attribute is created lazily on first bound computation)
 _MEMO_GUARD = threading.Lock()
 
+# member count past which the UNaggregated kept-replica LP is considered
+# intractable (the 50k-partition jumbo's ~150k members time out at 900 s)
+# and the symmetry-aggregated formulation takes over — in the bound
+# ladder and in the plan constructor (solvers.lp_round)
+AGG_MEMBER_THRESHOLD = 60_000
+
 from .cluster import Assignment, PartitionAssignment, Topology
 
 # Objective weight tiers (README.md:146 observed values).
@@ -418,8 +424,18 @@ class ProblemInstance:
           forced new replicas per broker/rack — needed when brokers are
           over-full (scale-out). Seconds at 10k partitions, so only on
           explicit request (the engine runs it on a worker thread).
+          Past ~60k members the unaggregated LP is intractable (the
+          50k-partition jumbo times it out at 900 s) and the tier
+          switches to the SYMMETRY-AGGREGATED formulation
+          (``_kept_weight_agg``) — the exact same LP optimum at
+          ~#classes/#partitions of the cost.
+        - level 3: the aggregated kept-replica MILP's branch-and-bound
+          dual bound (``_kept_weight_agg(integer=True)``) — integer
+          aggregation is a valid relaxation of the true MILP, so this
+          can only tighten level 2; time-limited, any size with few
+          classes.
 
-        ``certify_optimal`` escalates 0 -> 1 -> 2.
+        ``certify_optimal`` escalates 0 -> 1 -> 2 -> 3.
 
         Thread-safe: the tier ladder runs under a per-instance lock
         (the engine prefetches bounds on worker threads while the main
@@ -439,12 +455,14 @@ class ProblemInstance:
                 lead = self._leader_cap_lp(with_lower=False)
                 mw = self.max_weight()
                 memo[0] = mw if lead is None else min(mw, lead)
-            # LP cost grows superlinearly in member count; past ~60k
-            # members (20k partitions at RF=3) the higher levels stick
-            # with the cheaper bound rather than stall a certificate
-            # check for tens of seconds (a HiGHS time_limit bounds them
-            # regardless)
-            big = level >= 1 and self._members()[0].size > 60_000
+            # LP cost grows superlinearly in member count; past the
+            # aggregation threshold the level-1 LP sticks with the
+            # cheaper bound and level 2 switches to the aggregated
+            # formulation (exact; see _kept_weight_agg)
+            big = (
+                level >= 1
+                and self._members()[0].size > AGG_MEMBER_THRESHOLD
+            )
             if level >= 1 and 1 not in memo:
                 if getattr(self, "_bounds_cancelled", False):
                     return memo[0]
@@ -453,8 +471,16 @@ class ProblemInstance:
             if level >= 2 and 2 not in memo:
                 if getattr(self, "_bounds_cancelled", False):
                     return memo[1]
-                kept = None if big else self._kept_weight_lp()
+                kept = (
+                    self._kept_weight_agg() if big
+                    else self._kept_weight_lp()
+                )
                 memo[2] = memo[1] if kept is None else min(memo[1], kept)
+            if level >= 3 and 3 not in memo:
+                if getattr(self, "_bounds_cancelled", False):
+                    return memo[2]
+                kept = self._kept_weight_agg(integer=True)
+                memo[3] = memo[2] if kept is None else min(memo[2], kept)
             return memo[level]
 
     def _memo_lock(self) -> threading.Lock:
@@ -886,6 +912,274 @@ class ProblemInstance:
         except Exception:
             return None
 
+    def _member_classes(self):
+        """Partition-symmetry classes for the aggregated kept-weight
+        bound: partitions are interchangeable in the level-2 LP when
+        they share (rf, part_rack_hi, sorted member (broker, w_leader,
+        w_follower) triples). Generated clusters — and real round-robin
+        Kafka clusters — have FAR fewer classes than partitions (the
+        50k-partition jumbo instance has 543), which is what makes the
+        level-2 bound affordable at any size.
+
+        Returns (cls_parts, cls_rf, cls_prh, cm_cls, cm_broker, cm_wl,
+        cm_wf): per-class partition lists and rf/prh, plus flattened
+        class-member arrays. Memoized."""
+        cached = getattr(self, "_member_classes_memo", None)
+        if cached is not None:
+            return cached
+        import collections
+
+        mrows, mcols = self._members()
+        wl = self.w_leader[mrows, mcols]
+        wf = np.maximum(self.w_follower[mrows, mcols], 0)
+        per = collections.defaultdict(list)
+        for r, c, a, b in zip(mrows.tolist(), mcols.tolist(),
+                              wl.tolist(), wf.tolist()):
+            per[r].append((c, a, b))
+        groups: dict = collections.defaultdict(list)
+        rf_l = self.rf.tolist()
+        prh_l = self.part_rack_hi.tolist()
+        for p in range(self.num_parts):
+            key = (rf_l[p], prh_l[p], tuple(sorted(per[p])))
+            groups[key].append(p)
+        cls_parts, cls_rf, cls_prh = [], [], []
+        cm_cls, cm_broker, cm_wl, cm_wf = [], [], [], []
+        for ci, (key, parts) in enumerate(groups.items()):
+            rff, prh, members = key
+            cls_parts.append(parts)
+            cls_rf.append(rff)
+            cls_prh.append(prh)
+            for (b, a, f) in members:
+                cm_cls.append(ci)
+                cm_broker.append(b)
+                cm_wl.append(a)
+                cm_wf.append(f)
+        out = (
+            cls_parts,
+            np.array(cls_rf, np.int64),
+            np.array(cls_prh, np.int64),
+            np.array(cm_cls, np.int64),
+            np.array(cm_broker, np.int64),
+            np.array(cm_wl, np.int64),
+            np.array(cm_wf, np.int64),
+        )
+        self._member_classes_memo = out
+        return out
+
+    def _kept_weight_agg(self, integer: bool = False,
+                         return_solution: bool = False):
+        """The level-2 kept-weight bound on the SYMMETRY-AGGREGATED
+        model — exactly the same polytope as ``_kept_weight_lp`` but
+        with one variable per (class, member) instead of per
+        (partition, member).
+
+        Exactness: the LP optimum is invariant under aggregation —
+        averaging any optimum over a class's partitions (they have
+        identical members, weights, rf and caps) is feasible with the
+        same objective, and symmetric solutions biject with the
+        aggregated ones (every aggregated row is the sum of the
+        partition rows it replaces). So this IS the level-2 LP bound,
+        at ~#classes/#partitions of the cost — 0.5 s where the
+        unaggregated LP times out at 900 s (50k-partition jumbo).
+
+        ``integer=True`` solves the aggregated MILP instead: integer
+        symmetrization is only into (every real plan maps to an integer
+        aggregate; not every integer aggregate is realizable), so its
+        optimum — or its dual bound under a time limit — is a still-
+        valid, potentially TIGHTER upper bound than the LP (the
+        ``weight_upper_bound`` level-3 tier).
+
+        ``return_solution`` (with ``integer=True``) returns the raw
+        aggregated solution for the plan constructor
+        (``solvers.lp_round``): a dict with per-class-member kept
+        counts X/Y, per-broker new-replica quotas z and non-kept-leader
+        quotas u, plus the class arrays to disaggregate with."""
+        try:
+            import scipy.sparse as sp
+            from scipy.optimize import linprog
+        except Exception:
+            return None
+        (cls_parts, cls_rf, cls_prh, cm_cls, cm_broker, cm_wl, cm_wf
+         ) = self._member_classes()
+        n_cm = cm_broker.size
+        if n_cm == 0:
+            return None if return_solution else 0
+        # the formulation only pays off when symmetry actually shrinks
+        # the problem: on clusters with near-distinct per-partition
+        # weights (#classes ~ #partitions) this would be a full-size
+        # MILP burning its whole time limit to restate the level-2
+        # verdict — refuse instead of grinding (certify_optimal and the
+        # serve audit run these tiers synchronously)
+        members = self._members()[0].size
+        if members > 20_000 and n_cm > members // 4:
+            return None
+        opts = self._lp_options()
+        if opts is None:  # bounds deadline already spent
+            return None
+        try:
+            B, K = self.num_brokers, self.num_racks
+            C = len(cls_parts)
+            cls_n = np.array([len(p) for p in cls_parts], np.float64)
+            cm_n = cls_n[cm_cls]
+            rack = self.rack_of_broker[cm_broker]
+            p_active = float((self.rf > 0).sum())
+            r_total = float(self.total_replicas)
+            ncols = 2 * n_cm + 2 * B
+            u_off, z_off = 2 * n_cm, 2 * n_cm + B
+            var = np.arange(n_cm)
+
+            def block(r, c, nrows):
+                return sp.csr_matrix(
+                    (np.ones(len(c)), (r, c)), shape=(nrows, ncols)
+                )
+
+            def both(r, nrows):
+                return block(
+                    np.concatenate([r, r]),
+                    np.concatenate([var, var + n_cm]),
+                    nrows,
+                )
+
+            b_idx = np.arange(B)
+            pk = cm_cls * K + rack
+            pairs, pair_idx = np.unique(pk, return_inverse=True)
+            lead_b = block(cm_broker, var + n_cm, B) + block(
+                b_idx, u_off + b_idx, B
+            )
+            repl_b = both(cm_broker, B) + block(b_idx, z_off + b_idx, B)
+            rack_rows = both(rack, K) + block(
+                self.rack_of_broker[:B], z_off + b_idx, K
+            )
+            # u_b <= z_b: a lead through a non-kept leader sits on one
+            # of that broker's NEW replicas (valid for every real plan;
+            # tightens the aggregate against phantom leaderships)
+            uz = sp.csr_matrix(
+                (np.concatenate([np.ones(B), -np.ones(B)]),
+                 (np.concatenate([b_idx, b_idx]),
+                  np.concatenate([u_off + b_idx, z_off + b_idx]))),
+                shape=(B, ncols),
+            )
+            a_ub = sp.vstack(
+                [
+                    both(var, n_cm),              # X+Y <= n_c per member
+                    block(cm_cls, var + n_cm, C),  # sum Y <= n_c
+                    both(cm_cls, C),              # sum(X+Y) <= n_c rf
+                    both(pair_idx, pairs.size),   # diversity pairs
+                    block(cm_cls, var, C),        # sum X <= n_c (rf-1):
+                    # a fully-kept partition keeps its leader, so kept
+                    # FOLLOWERS never exceed rf-1
+                    lead_b, -lead_b,
+                    repl_b, -repl_b,
+                    rack_rows, -rack_rows,
+                    uz,
+                ],
+                format="csr",
+            )
+            b_ub = np.concatenate(
+                [
+                    cm_n,
+                    cls_n,
+                    cls_n * cls_rf,
+                    (cls_n * cls_prh)[(pairs // K)],
+                    cls_n * np.maximum(cls_rf - 1, 0),
+                    np.full(B, float(self.leader_hi)),
+                    np.full(B, -float(self.leader_lo)),
+                    np.full(B, float(self.broker_hi)),
+                    np.full(B, -float(self.broker_lo)),
+                    self.rack_hi.astype(np.float64),
+                    -self.rack_lo.astype(np.float64),
+                    np.zeros(B),
+                ]
+            )
+            a_eq = sp.vstack(
+                [
+                    block(
+                        np.zeros(n_cm + B, np.int64),
+                        np.concatenate([var + n_cm, u_off + b_idx]),
+                        1,
+                    ),
+                    block(
+                        np.zeros(2 * n_cm + B, np.int64),
+                        np.concatenate(
+                            [var, var + n_cm, z_off + b_idx]
+                        ),
+                        1,
+                    ),
+                ],
+                format="csr",
+            )
+            b_eq = np.array([p_active, r_total])
+            if return_solution:
+                # lexicographic: weight dominant, kept count tie-break
+                scale = float(self.total_replicas + 1)
+                c = -np.concatenate(
+                    [scale * cm_wf + 1, scale * cm_wl + 1,
+                     np.zeros(2 * B)]
+                )
+            else:
+                c = -np.concatenate(
+                    [cm_wf.astype(np.float64), cm_wl.astype(np.float64),
+                     np.zeros(2 * B)]
+                )
+            lo = np.zeros(ncols)
+            hi = np.concatenate(
+                [cm_n, cm_n, np.full(B, p_active), np.full(B, r_total)]
+            )
+            if integer:
+                from scipy.optimize import (
+                    Bounds, LinearConstraint, milp,
+                )
+
+                res = milp(
+                    c,
+                    constraints=[
+                        LinearConstraint(a_ub, -np.inf, b_ub),
+                        LinearConstraint(a_eq, b_eq, b_eq),
+                    ],
+                    bounds=Bounds(lo, hi),
+                    integrality=np.ones(ncols),
+                    options={"time_limit": opts["time_limit"],
+                             "mip_rel_gap": 0.0},
+                )
+                if return_solution:
+                    if not res.success or res.x is None:
+                        return None
+                    sol = np.rint(res.x)
+                    if np.abs(res.x - sol).max(initial=0) > 1e-6:
+                        return None
+                    return {
+                        "X": sol[:n_cm].astype(np.int64),
+                        "Y": sol[n_cm:2 * n_cm].astype(np.int64),
+                        "u": sol[u_off:u_off + B].astype(np.int64),
+                        "z": sol[z_off:z_off + B].astype(np.int64),
+                        "cls_parts": cls_parts,
+                        "cls_rf": cls_rf,
+                        "cls_prh": cls_prh,
+                        "cm_cls": cm_cls,
+                        "cm_broker": cm_broker,
+                        "cm_wl": cm_wl,
+                        "cm_wf": cm_wf,
+                    }
+                # branch-and-bound dual bound: valid even on timeout
+                db = getattr(res, "mip_dual_bound", None)
+                if db is None or not np.isfinite(db):
+                    return None
+                return _safe_floor_ub(db)
+            res = linprog(
+                c, A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
+                bounds=np.stack([lo, hi], axis=1), method="highs",
+                options=opts,
+            )
+            if not res.success:
+                return None
+            ub = _dual_repair_max_ub(c, a_ub, b_ub, a_eq, b_eq, lo, hi,
+                                     res)
+            if ub is None:
+                return _safe_floor_ub(res.fun)
+            return _safe_floor_ub(-max(ub, -res.fun))
+        except Exception:
+            return None
+
     def best_leader_assignment(self, a: np.ndarray) -> np.ndarray:
         """Exact optimal leader choice for FIXED replica sets: permute
         each partition's slots so the leader (slot 0) maximizes the total
@@ -1049,8 +1343,10 @@ class ProblemInstance:
         # disable the synchronous escalation
         if not allow_tight:
             return False
-        return w >= self.weight_upper_bound(level=1) or (
-            w >= self.weight_upper_bound(level=2)
+        return (
+            w >= self.weight_upper_bound(level=1)
+            or w >= self.weight_upper_bound(level=2)
+            or w >= self.weight_upper_bound(level=3)
         )
 
 

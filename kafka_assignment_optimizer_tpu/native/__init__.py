@@ -85,6 +85,72 @@ def load() -> ctypes.CDLL:
 
 
 # ---------------------------------------------------------------------------
+# min-cost max-flow kernel (mcmf.cpp) — leader-aware plan completion
+
+_MCMF_SRC = Path(__file__).with_name("mcmf.cpp")
+
+
+def mcmf_lib_path() -> Path:
+    digest = hashlib.sha256(_MCMF_SRC.read_bytes()).hexdigest()[:16]
+    return _build_dir() / f"libkao_mcmf_{digest}.so"
+
+
+_MCMF_LIB: ctypes.CDLL | None = None
+
+
+def load_mcmf() -> ctypes.CDLL:
+    global _MCMF_LIB
+    if _MCMF_LIB is None:
+        path = _compile(_MCMF_SRC, mcmf_lib_path(),
+                        ["-O3", "-shared", "-fPIC"])
+        lib = ctypes.CDLL(str(path))
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.kao_mcmf.restype = ctypes.c_int
+        lib.kao_mcmf.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,  # n_nodes n_arcs
+            i32p, i32p, i32p, i32p,          # src dst cap cost
+            ctypes.c_int32, ctypes.c_int32,  # s t
+            i32p, i64p, i64p,                # out_arc_flow out_flow out_cost
+        ]
+        _MCMF_LIB = lib
+    return _MCMF_LIB
+
+
+def mcmf(src, dst, cap, cost, s: int, t: int, n_nodes: int):
+    """Min-cost max-flow via the native kernel. Returns
+    (total_flow, total_cost, per_arc_flow) or raises RuntimeError —
+    rc=-1 for malformed input, rc=-2 when a negative-cost cycle is
+    reachable (outside the successive-shortest-paths contract; the
+    completion networks are DAG-layered so this never fires there)."""
+    import numpy as np
+
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    cap = np.ascontiguousarray(cap, dtype=np.int32)
+    cost = np.ascontiguousarray(cost, dtype=np.int32)
+    n_arcs = src.size
+    if not (dst.size == cap.size == cost.size == n_arcs):
+        raise ValueError("arc arrays must have equal length")
+    flow_out = np.zeros(n_arcs, dtype=np.int32)
+    tf = ctypes.c_int64()
+    tc = ctypes.c_int64()
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib = load_mcmf()
+    rc = lib.kao_mcmf(
+        ctypes.c_int32(n_nodes), ctypes.c_int32(n_arcs),
+        src.ctypes.data_as(i32p), dst.ctypes.data_as(i32p),
+        cap.ctypes.data_as(i32p), cost.ctypes.data_as(i32p),
+        ctypes.c_int32(s), ctypes.c_int32(t),
+        flow_out.ctypes.data_as(i32p),
+        ctypes.byref(tf), ctypes.byref(tc),
+    )
+    if rc != 0:
+        raise RuntimeError(f"kao_mcmf rejected the input (rc={rc})")
+    return int(tf.value), int(tc.value), flow_out
+
+
+# ---------------------------------------------------------------------------
 # bundled lp_solve work-alike CLI (lp_cli.cpp)
 
 _LP_SRC = Path(__file__).with_name("lp_cli.cpp")

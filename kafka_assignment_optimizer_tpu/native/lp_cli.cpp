@@ -491,6 +491,44 @@ struct Solver {
     return from;
   }
 
+  // Phase-1 search controls: stop at the first feasible leaf, and cap
+  // the node budget so a hopeless dive hands over to the exact phase.
+  bool first_feasible_only = false;
+  bool phase_aborted = false;
+  uint64_t node_cap = 0;
+
+  void recompute_suffix() {
+    for (int i = n - 1; i >= 0; --i)
+      pos_suffix[i] =
+          pos_suffix[i + 1] + std::max<int64_t>(0, signed_obj(order[i]));
+  }
+
+  // Feasibility-first variable order: complete one demand row (lo > 0
+  // — the RF / one-leader equalities of this model family) at a time,
+  // in file order. Propagation then keeps each dive's backtracking
+  // local to a partition block. The objective-major order is the right
+  // one for PRUNING but can thrash for hours on tight capacity bands
+  // before reaching ANY feasible leaf (fuzz-found: RF=4 clusters with
+  // 1-broker racks gave rc=7 at 120 s while the incumbent-seeded
+  // search proves optimality in milliseconds).
+  void use_feasibility_order() {
+    std::vector<int> neworder;
+    neworder.reserve(n);
+    std::vector<uint8_t> seen(n, 0);
+    for (const Row &row : m.rows) {
+      if (row.lo <= 0) continue;
+      for (const Term &t : row.terms)
+        if (!seen[t.var]) {
+          seen[t.var] = 1;
+          neworder.push_back(t.var);
+        }
+    }
+    for (int v = 0; v < n; ++v)
+      if (!seen[v]) neworder.push_back(v);
+    order = std::move(neworder);
+    recompute_suffix();
+  }
+
   void record_if_better() {
     if (cur_obj > best_obj) {
       best_obj = cur_obj;
@@ -501,6 +539,11 @@ struct Solver {
 
   void dfs(int depth) {
     if (out_of_time()) return;
+    if (first_feasible_only && have_best) return;
+    if (node_cap && nodes >= node_cap) {
+      phase_aborted = true;
+      return;
+    }
     ++nodes;
     // bound: cheap suffix first, then the row-capacity cover bound
     if (have_best && cur_obj + pos_suffix[depth] <= best_obj) return;
@@ -513,14 +556,29 @@ struct Solver {
     int var = order[i];
     // prefer keeping weighted (currently-assigned) vars and LEAVING OUT
     // unweighted ones — flooding zero-weight vars with 1s only violates
-    // capacity bands and thrashes the feasibility search
+    // capacity bands and thrashes the feasibility search. In the
+    // feasibility phase the preference is demand-driven instead: a var
+    // that can still lift an unsatisfied >=-row (a leader/replica
+    // lower band) goes in — without this, lower-band violations
+    // surface only at the bottom of the dive, where chronological
+    // backtracking cannot escape them (fuzz-found: exact rack bands +
+    // per-broker leader floors).
     int8_t pref = signed_obj(var) > 0 ? 1 : 0;
+    if (first_feasible_only && pref == 0) {
+      for (auto [r, c] : var_rows[var])
+        if (c > 0 && act_lo[r] < m.rows[r].lo) {
+          pref = 1;
+          break;
+        }
+    }
     for (int8_t v : {pref, (int8_t)(1 - pref)}) {
       Trail tr;
       std::vector<int> dirty;
       if (assign(var, v, tr, dirty) && propagate(tr, dirty)) dfs(i + 1);
       undo(tr);
-      if (timed_out) return;
+      if (timed_out || phase_aborted ||
+          (first_feasible_only && have_best))
+        return;
     }
   }
 
@@ -530,7 +588,22 @@ struct Solver {
     std::vector<int> all(m.rows.size());
     for (size_t r = 0; r < m.rows.size(); ++r) all[r] = (int)r;
     if (!propagate(root, all)) return 2;  // infeasible at the root
+    // phase 1: demand-row-major feasibility dive to seed an incumbent
+    // (node-capped; root-propagation fixes persist, its own trail
+    // unwinds fully). Phase 2 re-proves/improves it exactly, so a
+    // skipped or failed phase 1 costs nothing but the node budget.
+    const std::vector<int> obj_order = order;
+    use_feasibility_order();
+    first_feasible_only = true;
+    node_cap = nodes + 2000000;
     dfs(0);
+    first_feasible_only = false;
+    phase_aborted = false;
+    node_cap = 0;
+    order = obj_order;
+    recompute_suffix();
+    // phase 2: exact objective-major branch-and-bound
+    if (!timed_out) dfs(0);
     if (!have_best) return timed_out ? 7 : 2;  // 7: no incumbent in time
     return timed_out ? 1 : 0;
   }

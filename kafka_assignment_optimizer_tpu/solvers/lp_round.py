@@ -32,32 +32,56 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..models import instance as _instance_mod
 from ..models.instance import ProblemInstance
 
 
 def construct(inst: ProblemInstance) -> np.ndarray | None:
-    """Decode the kept-replica LP into a full plan, or None."""
-    try:
-        sol = inst._kept_weight_lp(return_solution=True)
-    except Exception:
-        return None
-    if not isinstance(sol, dict):
-        return None
-    x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
-    z = np.asarray(sol["z"])
-    mrows, mcols = sol["mrows"], sol["mcols"]
+    """Decode the kept-replica LP into a full plan, or None.
 
-    # integral vertex required: kept roles and new-replica quotas must
-    # be whole (transportation structure makes this the common case)
-    if (
-        np.abs(x - np.rint(x)).max(initial=0) > 1e-6
-        or np.abs(y - np.rint(y)).max(initial=0) > 1e-6
-        or np.abs(z - np.rint(z)).max(initial=0) > 1e-6
-    ):
-        return None
-    xi = np.rint(x).astype(bool)
-    yi = np.rint(y).astype(bool)
-    quota = np.rint(z).astype(np.int64)
+    Past ~60k members the unaggregated LP is intractable; the
+    symmetry-aggregated MILP (``_kept_weight_agg``) is solved instead
+    and its per-class kept counts are realized into per-partition
+    choices (``_disaggregate``) — partitions within a class are
+    exchangeable, so any realization of the counts is optimal."""
+    members = inst._members()[0].size
+    if members > _instance_mod.AGG_MEMBER_THRESHOLD:
+        try:
+            agg = inst._kept_weight_agg(integer=True,
+                                        return_solution=True)
+        except Exception:
+            return None
+        if not isinstance(agg, dict):
+            return None
+        d = _disaggregate(inst, agg)
+        if d is None:
+            return None
+        xi, yi = d["x"], d["y"]
+        quota = agg["z"].astype(np.int64)
+        mrows, mcols = d["mrows"], d["mcols"]
+    else:
+        try:
+            sol = inst._kept_weight_lp(return_solution=True)
+        except Exception:
+            return None
+        if not isinstance(sol, dict):
+            return None
+        x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
+        z = np.asarray(sol["z"])
+        mrows, mcols = sol["mrows"], sol["mcols"]
+
+        # integral vertex required: kept roles and new-replica quotas
+        # must be whole (transportation structure makes this the
+        # common case)
+        if (
+            np.abs(x - np.rint(x)).max(initial=0) > 1e-6
+            or np.abs(y - np.rint(y)).max(initial=0) > 1e-6
+            or np.abs(z - np.rint(z)).max(initial=0) > 1e-6
+        ):
+            return None
+        xi = np.rint(x).astype(bool)
+        yi = np.rint(y).astype(bool)
+        quota = np.rint(z).astype(np.int64)
 
     P, R = inst.num_parts, inst.max_rf
     B, K = inst.num_brokers, inst.num_racks
@@ -87,7 +111,26 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
     if need != int(quota.sum()):
         return None
     if need > 0:
-        assign = _complete_maxflow(inst, a, vac, quota)
+        # leader-aware completion first: partitions left without a kept
+        # leader must receive one of their new replicas on a broker
+        # with leadership headroom, or the final exact reseat is forced
+        # to demote kept leaders elsewhere (observed: -67 weight on the
+        # 50k-partition jumbo with the blind completion). Min-cost
+        # max-flow places every vacancy AND maximizes lead-capable
+        # coverage jointly; the plain max-flow remains the fallback
+        # when the native kernel is unavailable.
+        has_lead = np.zeros(P, dtype=bool)
+        has_lead[mrows[yi]] = True
+        leaderless = (~has_lead) & (inst.rf > 0)
+        lead_cnt = np.bincount(mcols[yi], minlength=B + 1)[:B]
+        lead_quota = np.maximum(inst.leader_hi - lead_cnt, 0)
+        assign = None
+        if leaderless.any():
+            assign = _complete_mcmf(
+                inst, a, vac, leaderless, lead_quota
+            )
+        if assign is None:
+            assign = _complete_maxflow(inst, a, vac, quota)
         if assign is None:
             return None
         for p, b in assign:
@@ -102,6 +145,228 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
     if not inst.is_feasible(a):
         return None
     return a
+
+
+def _disaggregate(inst, agg):
+    """Realize the aggregated MILP's per-(class, member) kept counts as
+    per-partition selections.
+
+    Partitions within a class are exchangeable (identical members,
+    weights, rf, caps), so ANY realization of the counts has the same
+    objective; this greedy spreads each member's remaining demand
+    most-constrained-first, giving at most one leader per partition and
+    respecting the per-rack diversity cap. The aggregate rows guarantee
+    per-partition feasibility on average; the greedy can in principle
+    strand demand on adversarial instances — the caller verifies the
+    final plan and falls back, so a stranded realization costs nothing
+    but the attempt (it returns the partial keeps, still a valid warm
+    start)."""
+    import collections
+
+    mrows, mcols = inst._members()
+    idx_of = {}
+    for i, (r, c) in enumerate(zip(mrows.tolist(), mcols.tolist())):
+        idx_of[(r, c)] = i
+    x = np.zeros(mrows.size, dtype=bool)
+    y = np.zeros(mrows.size, dtype=bool)
+    cm_by_cls = collections.defaultdict(list)
+    for j in range(agg["cm_cls"].size):
+        cm_by_cls[int(agg["cm_cls"][j])].append(j)
+    rack_of = inst.rack_of_broker
+    cm_broker = agg["cm_broker"]
+    X, Y = agg["X"], agg["Y"]
+    for ci, parts in enumerate(agg["cls_parts"]):
+        cms = cm_by_cls[ci]
+        xr = {j: int(X[j]) for j in cms}
+        yr = {j: int(Y[j]) for j in cms}
+        prh = int(agg["cls_prh"][ci])
+        rf_c = int(agg["cls_rf"][ci])
+        for p in parts:
+            rack_load: collections.Counter = collections.Counter()
+            placed = 0
+            lead_j = None
+            cands = sorted(cms, key=lambda j: -yr[j])
+            if cands and yr[cands[0]] > 0:
+                lead_j = cands[0]
+                rack_load[int(rack_of[cm_broker[lead_j]])] += 1
+                i = idx_of.get((p, int(cm_broker[lead_j])))
+                if i is None:
+                    return None
+                y[i] = True
+                yr[lead_j] -= 1
+                placed = 1
+            for j in sorted(cms, key=lambda j: -xr[j]):
+                # rf cap: front-loading a class's keep counts into its
+                # early partitions must not exceed any partition's rf
+                # (RF-shrink classes have more members than rf)
+                if placed >= rf_c:
+                    break
+                if j == lead_j or xr[j] <= 0:
+                    continue
+                rk = int(rack_of[cm_broker[j]])
+                if rack_load[rk] >= prh:
+                    continue
+                i = idx_of.get((p, int(cm_broker[j])))
+                if i is None:
+                    return None
+                x[i] = True
+                xr[j] -= 1
+                rack_load[rk] += 1
+                placed += 1
+    return {"x": x, "y": y, "mrows": mrows, "mcols": mcols}
+
+
+def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
+    """Leader-aware completion: one min-cost max-flow placing every
+    vacancy directly against the BAND SLACK (per-broker and per-rack
+    capacity left by the keeps) rather than a fixed per-broker quota —
+    the LP's z quotas satisfy the bands but cannot see the (partition,
+    broker) pairing, and a blind realization of them strands lead
+    coverage (observed: -9 weight on the jumbo instance).
+
+    Cost structure (min-cost at max flow):
+    - arcs giving a LEADERLESS partition a new replica on a broker with
+      leadership headroom (capped per broker by ``lead_quota`` through
+      a gateway node) carry cost -1 -> coverage is maximized, so the
+      final exact reseat is not forced to demote kept leaders;
+    - the first ``broker_lo - kept`` / ``rack_lo - kept`` units into a
+      below-floor broker/rack carry cost -1000 -> band deficits are
+      filled with absolute priority (a completion that leaves a floor
+      unmet is infeasible anyway).
+
+    Returns [(p, broker)] or None; the caller verifies the final plan,
+    so any shortfall here only costs the attempt."""
+    try:
+        from ..native import mcmf
+    except Exception:
+        return None
+    P, R = a.shape
+    B, K = inst.num_brokers, inst.num_racks
+    rack_of = inst.rack_of_broker[:B].astype(np.int64)
+    filled = a != B
+    kept_b = np.bincount(
+        a[filled].astype(np.int64), minlength=B + 1
+    )[:B]
+    cap_b = np.maximum(inst.broker_hi - kept_b, 0)
+    deficit_b = np.minimum(
+        np.maximum(inst.broker_lo - kept_b, 0), cap_b
+    )
+    kept_k = np.bincount(
+        inst.rack_of_broker[a[filled]], minlength=K + 1
+    )[:K]
+    cap_k = np.maximum(inst.rack_hi - kept_k, 0)
+    deficit_k = np.minimum(np.maximum(inst.rack_lo - kept_k, 0), cap_k)
+    qb = np.flatnonzero(cap_b > 0)
+    pv = np.flatnonzero(vac > 0)
+    if qb.size == 0 or pv.size == 0:
+        return None
+    kept_rack = np.zeros((P, K + 1), dtype=np.int64)
+    np.add.at(
+        kept_rack,
+        (np.arange(P)[:, None].repeat(R, 1)[filled],
+         inst.rack_of_broker[a[filled]]),
+        1,
+    )
+    rem = inst.part_rack_hi[:, None] - kept_rack[:, :K]
+    qr = np.unique(rack_of[qb])
+    grid_p = np.repeat(pv, qr.size)
+    grid_k = np.tile(qr, pv.size)
+    keep = rem[grid_p, grid_k] > 0
+    pk_p, pk_k = grid_p[keep], grid_k[keep]
+    U = pk_p.size
+    if U == 0:
+        return None
+    pair_of = np.full(P * K, -1, dtype=np.int64)
+    pair_of[pk_p * K + pk_k] = np.arange(U)
+    in_part = np.zeros((P, B + 1), dtype=bool)
+    rows_f, cols_f = np.nonzero(filled)
+    in_part[rows_f, a[rows_f, cols_f]] = True
+
+    # node ids: 0 source | parts | pairs | lead gateways | brokers |
+    # racks | sink
+    o_part, o_pair = 1, 1 + P
+    o_gate = o_pair + U
+    o_brok = o_gate + B
+    o_rack = o_brok + B
+    t = o_rack + K
+    # candidate (p, b) edges
+    eb_p = np.repeat(pv, qb.size)
+    eb_b = np.tile(qb, pv.size)
+    pid = pair_of[eb_p * K + rack_of[eb_b]]
+    ok_e = (pid >= 0) & ~in_part[eb_p, eb_b]
+    eb_p, eb_b, pid = eb_p[ok_e], eb_b[ok_e], pid[ok_e]
+    # lead-channel edges REPLACE the direct edge for that (p, b) pair,
+    # so per-(p, b) uniqueness holds without extra nodes
+    lead_e = leaderless[eb_p] & (lead_quota[eb_b] > 0)
+    DEFICIT_REWARD = 1000
+    b_idx = np.arange(B)
+    k_idx = np.arange(K)
+    src = [
+        np.zeros(pv.size, np.int64),        # s -> p
+        o_part + pk_p,                      # p -> (p, k)
+        o_pair + pid[~lead_e],              # (p, k) -> b   (plain)
+        o_pair + pid[lead_e],               # (p, k) -> gate (lead)
+        o_gate + b_idx,                     # gate -> b
+        o_brok + qb,                        # b -> rack: deficit channel
+        o_brok + qb,                        # b -> rack: remaining slack
+        o_rack + k_idx,                     # rack -> t: deficit channel
+        o_rack + k_idx,                     # rack -> t: remaining slack
+    ]
+    dst = [
+        o_part + pv,
+        o_pair + np.arange(U),
+        o_brok + eb_b[~lead_e],
+        o_gate + eb_b[lead_e],
+        o_brok + b_idx,
+        o_rack + rack_of[qb],
+        o_rack + rack_of[qb],
+        np.full(K, t, np.int64),
+        np.full(K, t, np.int64),
+    ]
+    cap = [
+        vac[pv],
+        np.minimum(rem[pk_p, pk_k], vac[pk_p]),
+        np.ones(int((~lead_e).sum()), np.int64),
+        np.ones(int(lead_e.sum()), np.int64),
+        np.minimum(lead_quota, cap_b),
+        deficit_b[qb],
+        (cap_b - deficit_b)[qb],
+        deficit_k,
+        cap_k - deficit_k,
+    ]
+    cost = [
+        np.zeros(pv.size, np.int64),
+        np.zeros(U, np.int64),
+        np.zeros(int((~lead_e).sum()), np.int64),
+        -np.ones(int(lead_e.sum()), np.int64),
+        np.zeros(B, np.int64),
+        np.full(qb.size, -DEFICIT_REWARD, np.int64),
+        np.zeros(qb.size, np.int64),
+        np.full(K, -DEFICIT_REWARD, np.int64),
+        np.zeros(K, np.int64),
+    ]
+    src = np.concatenate(src)
+    dst = np.concatenate(dst)
+    cap = np.concatenate(cap)
+    cost = np.concatenate(cost)
+    try:
+        flow, _cost, arc_flow = mcmf(src, dst, cap, cost, 0, t, t + 1)
+    except Exception:
+        return None
+    if flow != int(vac.sum()):
+        return None
+    out = []
+    n0 = pv.size + U
+    n_plain = int((~lead_e).sum())
+    pf = arc_flow[n0:n0 + n_plain]
+    for i in np.flatnonzero(pf):
+        out.extend([(int(eb_p[~lead_e][i]), int(eb_b[~lead_e][i]))]
+                   * int(pf[i]))
+    lf = arc_flow[n0 + n_plain:n0 + n_plain + int(lead_e.sum())]
+    for i in np.flatnonzero(lf):
+        out.extend([(int(eb_p[lead_e][i]), int(eb_b[lead_e][i]))]
+                   * int(lf[i]))
+    return out
 
 
 def _complete_maxflow(inst, a, vac, quota):

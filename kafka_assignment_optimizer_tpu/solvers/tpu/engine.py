@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...models import instance as _instance_mod
 from ...models.instance import ProblemInstance
 from ...utils import checkpoint as ckpt
 from ..base import SolveResult, register
@@ -142,12 +143,18 @@ def solve_tpu(
     # when balance bands bind, a second worker decodes the kept-replica
     # LP into a plan (solvers.lp_round) — usually the certified global
     # optimum, letting the solve skip annealing (and often compilation)
-    # entirely. Decommission-style instances skip this: their caps are
-    # slack, the annealer certifies on its own, and the LP would waste
-    # seconds of host CPU.
+    # entirely. Small decommission-style instances skip this: their
+    # caps are slack, the annealer certifies on its own, and the LP
+    # would waste seconds of host CPU. PAST the unaggregated-LP size
+    # (~60k members) the constructor runs regardless: the aggregated
+    # MILP + leader-aware completion reaches optima the annealer's
+    # one-swap moves cannot (the 50k-partition jumbo's exact optimum
+    # needs coordinated leader-cascade placement), and at that scale
+    # it is CHEAPER than one compile of the sweep executable.
     lp_fut = (
         _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
         if _caps_bind(inst)
+        or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
         else None
     )
     res = _solve_tpu_inner(
@@ -403,9 +410,18 @@ def _solve_tpu_inner(
     # time, annealing starts and the boundaries keep watching for it.
     if lp_fut is not None:
         budget = _budget_left(t0, time_limit_s)
+        # adaptive wait: past the aggregation threshold — the same
+        # gate that launches the aggregated-MILP constructor above —
+        # the constructor (agg MILP + completion + exact reseat,
+        # ~15-20 s) is far cheaper than the first sweep-executable
+        # compile (minutes), so waiting longer for it is a net win;
+        # below it the snappy 5 s cap holds (the unaggregated-LP
+        # constructor either lands fast or the annealer should start)
+        big = inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+        wait_s = 45.0 if big else 5.0
         try:
             plan, ok = lp_fut.result(
-                timeout=5.0 if budget is None else min(5.0, budget)
+                timeout=wait_s if budget is None else min(wait_s, budget)
             )
         except Exception:
             plan, ok = None, False

@@ -1,0 +1,214 @@
+"""Symmetry-aggregated bounds + leader-aware construction — the
+machinery that certifies the 50k-partition jumbo scenario (r3).
+
+- ``ProblemInstance._kept_weight_agg``: the level-2 kept-replica bound
+  on the partition-symmetry-aggregated model (exact for the LP; the
+  integer mode is a valid, possibly tighter relaxation of the true
+  MILP).
+- ``native.mcmf``: the C++ min-cost max-flow kernel behind leader-aware
+  plan completion.
+- ``solvers.lp_round``: aggregated MILP -> disaggregation -> MCMF
+  completion path used past the unaggregated-LP size limit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.api import optimize
+from kafka_assignment_optimizer_tpu.models import instance as inst_mod
+from kafka_assignment_optimizer_tpu.models.instance import build_instance
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _inst(name, smoke=True):
+    kw = gen.SMOKE_KWARGS[name] if smoke else {}
+    sc = gen.SCENARIOS[name](**kw)
+    return sc, build_instance(
+        sc.current, sc.broker_list, sc.topology, target_rf=sc.target_rf
+    )
+
+
+# ---------------------------------------------------------------- mcmf
+
+
+def test_mcmf_known_answer():
+    from kafka_assignment_optimizer_tpu.native import mcmf
+
+    # 0->1(2,$0) 0->2(2,$0) 1->3(2,$1) 2->3(2,$0) 1->2(1,-$1):
+    # max-flow 4 forces both 0->1 units through the $1 arc
+    f, c, af = mcmf([0, 0, 1, 2, 1], [1, 2, 3, 3, 2],
+                    [2, 2, 2, 2, 1], [0, 0, 1, 0, -1], 0, 3, 4)
+    assert (f, c) == (4, 2)
+    assert af.tolist() == [2, 2, 2, 2, 0]
+    # disconnected sink
+    f, c, _ = mcmf([0], [1], [3], [5], 0, 2, 3)
+    assert f == 0
+
+
+def test_mcmf_matches_scipy_maxflow(rng):
+    """Flow value == scipy max-flow; conservation holds at every node.
+
+    Random DAGs (arcs only low->high node id), matching the kernel's
+    successive-shortest-paths contract: negative arc COSTS are legal,
+    negative-cost CYCLES are not (the completion networks are
+    DAG-layered, so cycles cannot arise in production)."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import maximum_flow
+
+    from kafka_assignment_optimizer_tpu.native import mcmf
+
+    for _ in range(30):
+        n = int(rng.integers(4, 12))
+        m = int(rng.integers(5, 30))
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        ok = src != dst
+        src, dst = (np.minimum(src, dst)[ok], np.maximum(src, dst)[ok])
+        cap = rng.integers(1, 9, src.size)
+        cost = rng.integers(-3, 4, src.size)
+        # coo->csr sums parallel-arc capacities, matching the kernel's
+        # independent parallel arcs in total s-t capacity
+        g = sp.coo_matrix((cap, (src, dst)), shape=(n, n)).tocsr()
+        ref = maximum_flow(g.astype(np.int32), 0, n - 1).flow_value
+        f, _c, af = mcmf(src, dst, cap, cost, 0, n - 1, n)
+        assert f == ref
+        net = np.zeros(n)
+        np.add.at(net, src, -af)
+        np.add.at(net, dst, af)
+        assert net[0] == -f and net[n - 1] == f
+        assert np.abs(net[1:n - 1]).max(initial=0) == 0
+        assert np.all(af >= 0) and np.all(af <= cap)
+
+
+def test_mcmf_rejects_negative_cycle():
+    """A residual-reachable negative-cost cycle is outside the SSP
+    contract: the kernel must detect it and raise (rc=-2), not spin
+    until the process aborts (fuzz-found crash class)."""
+    from kafka_assignment_optimizer_tpu.native import mcmf
+
+    # 0 -> 1 -> 2 -> 1 ... cycle 1->2->1 has total cost -1
+    with pytest.raises(RuntimeError):
+        mcmf([0, 1, 2, 2], [1, 2, 1, 3], [1, 5, 5, 1],
+             [0, -3, 2, 0], 0, 3, 4)
+
+
+# ------------------------------------------------- aggregated bound
+
+
+@pytest.mark.parametrize("name", list(gen.SCENARIOS))
+def test_agg_bound_matches_unaggregated(name):
+    """The aggregated LP bound equals the unaggregated level-2 LP up to
+    its extra (valid) cuts — never looser, and still a true upper bound
+    on the exact optimum."""
+    sc, inst = _inst(name)
+    unagg = inst._kept_weight_lp()
+    agg = inst._kept_weight_agg()
+    agg_milp = inst._kept_weight_agg(integer=True)
+    assert agg is not None and unagg is not None
+    assert agg <= unagg  # u<=z + leader-slot cuts can only tighten
+    assert agg_milp <= agg  # integer aggregation tightens further
+    if name == "jumbo":
+        return  # the exact-MILP oracle is minutes at jumbo-smoke size
+    ex = optimize(solver="milp", **sc.kwargs)
+    assert ex.solve.optimal
+    assert agg_milp >= ex.solve.objective  # soundness: valid relaxation
+
+
+def test_agg_bound_sound_on_random_clusters(rng):
+    """Aggregated LP/MILP bounds never undercut the exact optimum on
+    random lopsided clusters (certificate soundness)."""
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        Assignment,
+        PartitionAssignment,
+        Topology,
+    )
+
+    for trial in range(6):
+        n_b = int(rng.integers(5, 12))
+        n_racks = int(rng.integers(1, 4))
+        n_p = int(rng.integers(4, 24))
+        rf = int(rng.integers(1, min(4, n_b)))
+        topo = Topology.from_dict(
+            {str(b): f"r{b % n_racks}" for b in range(n_b)}
+        )
+        parts = [
+            PartitionAssignment(
+                topic="t", partition=p,
+                replicas=rng.choice(n_b, size=rf, replace=False).tolist(),
+            )
+            for p in range(n_p)
+        ]
+        drop = int(rng.integers(0, n_b)) if rng.random() < 0.5 else None
+        brokers = [b for b in range(n_b) if b != drop]
+        kw = dict(current=Assignment(partitions=parts),
+                  broker_list=brokers, topology=topo)
+        inst = build_instance(kw["current"], kw["broker_list"], topo)
+        ex = optimize(solver="milp", **kw)
+        assert ex.solve.optimal
+        for bound in (inst._kept_weight_agg(),
+                      inst._kept_weight_agg(integer=True)):
+            assert bound is not None
+            assert bound >= ex.solve.objective, trial
+
+
+def test_level3_in_ladder_monotone():
+    """weight_upper_bound levels are monotone non-increasing through
+    the new level-3 tier."""
+    _, inst = _inst("jumbo")
+    l0 = inst.weight_upper_bound(level=0)
+    l1 = inst.weight_upper_bound(level=1)
+    l2 = inst.weight_upper_bound(level=2)
+    l3 = inst.weight_upper_bound(level=3)
+    assert l0 >= l1 >= l2 >= l3
+
+
+# ------------------------------------------- aggregated construction
+
+
+@pytest.mark.parametrize("name", ["decommission", "scale_out", "jumbo"])
+def test_agg_construct_path_feasible(name, monkeypatch):
+    """Force the aggregated construct path (as used past the size
+    threshold) on small instances: the disaggregated, MCMF-completed,
+    reseated plan must be feasible and at least as good as the greedy
+    seed."""
+    from kafka_assignment_optimizer_tpu.solvers import lp_round
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+    monkeypatch.setattr(inst_mod, "AGG_MEMBER_THRESHOLD", 0)
+    sc, inst = _inst(name)
+    plan = lp_round.construct(inst)
+    if plan is None:
+        pytest.skip(f"aggregated vertex not realizable on {name} smoke")
+    assert inst.is_feasible(plan)
+    seed = greedy_seed(inst)
+    assert (
+        inst.preservation_weight(plan) >= inst.preservation_weight(seed)
+        or inst.move_count(plan) <= inst.move_count(seed)
+    )
+
+
+def test_jumbo_full_certified():
+    """THE r3 deliverable: the full 512-broker / 50k-partition jumbo
+    decommission is solved to a PROVEN global optimum by the aggregated
+    constructor — weight meets the bound, moves meet the exact max-flow
+    minimum — in seconds, no annealing involved."""
+    import time
+
+    from kafka_assignment_optimizer_tpu.solvers.lp_round import construct
+
+    sc, inst = _inst("jumbo", smoke=False)
+    t0 = time.perf_counter()
+    plan = construct(inst)
+    construct_s = time.perf_counter() - t0
+    assert plan is not None
+    assert inst.is_feasible(plan)
+    assert inst.move_count(plan) == inst.move_lower_bound_exact()
+    assert inst.preservation_weight(plan) == inst.weight_upper_bound(
+        level=0
+    )
+    assert inst.certify_optimal(plan)
+    # generous wall bound: ~7 s measured; catches an accidental return
+    # to the unaggregated 900 s regime
+    assert construct_s < 60, f"jumbo construct took {construct_s:.1f}s"

@@ -1,0 +1,171 @@
+"""Differential fuzz of the lp_solve dialect + certificate soak
+(VERDICT r2 item 5).
+
+The bundled CLI (``native/lp_cli.cpp``) is the de-facto reference
+solver when no system ``lp_solve`` exists, so its whole pipeline —
+``emit_lp`` -> subprocess -> ``-S4`` parse -> decode — is held to the
+in-process exact MILP on random lopsided clusters: mixed per-topic RF
+maps, 1-broker racks, broker removals and additions. Reference dialect:
+``/root/reference/README.md:144-185``.
+
+Soak mode (opt-in, release-blocking on any mismatch): set
+``KAO_SOAK=<n>`` to multiply the trial counts, e.g.::
+
+    KAO_SOAK=50 python -m pytest tests/test_lp_fuzz.py -q
+
+which runs ~50x the CI volume of both the dialect fuzz and the
+certificate-soundness soak (``docs/OPTIMALITY.md`` claims under
+adversarial evidence). CI keeps the bounded default so the suite stays
+fast.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance, optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+from kafka_assignment_optimizer_tpu.solvers.lp import (
+    lp_solve_available,
+    solve_lp_solve,
+)
+from kafka_assignment_optimizer_tpu.solvers.milp import solve_milp
+
+SOAK = int(os.environ.get("KAO_SOAK", "1"))
+
+
+def random_lopsided(rng):
+    """A cluster built to stress the dialect and the bounds: several
+    topics with DIFFERENT target RFs (per-topic RF map), racks of very
+    unequal size including 1-broker racks, and a broker list that may
+    drop and/or add brokers."""
+    n_b = int(rng.integers(5, 13))
+    n_topics = int(rng.integers(1, 4))
+    parts = []
+    rf_map = {}
+    for t in range(n_topics):
+        name = f"t{t}"
+        cur_rf = int(rng.integers(1, min(4, n_b) + 1))
+        if rng.random() < 0.5:
+            rf_map[name] = int(rng.integers(1, min(4, n_b) + 1))
+        for p in range(int(rng.integers(2, 8))):
+            reps = rng.choice(n_b, size=cur_rf, replace=False)
+            parts.append(
+                PartitionAssignment(name, p, [int(b) for b in reps])
+            )
+    # lopsided racks: rack 0 hoards brokers, the last rack often has 1
+    n_racks = int(rng.integers(1, 4))
+    add = int(rng.integers(0, 3))
+    all_ids = list(range(n_b + add))
+    rack = {
+        b: f"r{0 if b % 4 < 2 else (b % n_racks)}" for b in all_ids
+    }
+    rack[all_ids[-1]] = f"r{n_racks}"  # a 1-broker rack
+    drop = int(rng.integers(0, n_b)) if rng.random() < 0.5 else None
+    brokers = [b for b in all_ids if b != drop]
+    return dict(
+        current=Assignment(partitions=parts),
+        broker_list=brokers,
+        topology=Topology.from_dict(rack),
+        target_rf=rf_map or None,
+    )
+
+
+@pytest.mark.skipif(
+    not lp_solve_available(),
+    reason="no lp_solve binary and bundled lp_cli failed to build",
+)
+def test_lp_dialect_differential_fuzz(rng):
+    """emit_lp -> lp_cli -> parse == in-process exact MILP, on every
+    random lopsided cluster: same optimal objective, feasible decode.
+    Any mismatch is a release blocker."""
+    trials = 8 * SOAK
+    compared = hard = 0
+    for trial in range(trials):
+        kw = random_lopsided(rng)
+        try:
+            inst = build_instance(**kw)
+        except ValueError:
+            continue  # RF > broker count after a drop: invalid input
+        ex = solve_milp(inst)
+        if not ex.optimal:
+            continue
+        try:
+            lp = solve_lp_solve(inst, time_limit_s=15.0)
+        except RuntimeError:
+            # no incumbent within the limit: a search-depth pathology
+            # of the bundled DFS on extreme exact-band instances (the
+            # generator produces perfect-packing feasibility problems
+            # HiGHS needs LP relaxations for), NOT a dialect defect —
+            # the emitted LP was verified satisfiable by the MILP
+            # optimum when this class was first hit. Skipped, but
+            # floored below so wholesale breakage still fails.
+            hard += 1
+            continue
+        compared += 1
+        assert inst.is_feasible(lp.a), trial
+        if lp.optimal:
+            assert lp.objective == ex.objective, (
+                f"trial {trial}: lp_solve {lp.objective} "
+                f"!= milp {ex.objective}"
+            )
+        else:  # timeout incumbent may only undershoot
+            assert lp.objective <= ex.objective, trial
+    assert compared >= max(1, (compared + hard) // 2), (compared, hard)
+
+
+def test_certificate_soundness_soak(rng):
+    """Zero false ``proven_optimal``: every certificate the TPU engine
+    emits on random lopsided clusters must equal the exact MILP optimum.
+    Extends ``test_bounds.test_proof_claims_sound_on_random_clusters``
+    to soak volume under ``KAO_SOAK`` — the single most important
+    property of the bounds stack, now also covering per-topic RF maps
+    and 1-broker racks."""
+    trials = 4 * SOAK
+    proved = 0
+    for trial in range(trials):
+        kw = random_lopsided(rng)
+        try:
+            r = optimize(solver="tpu", seed=trial, rounds=32, **kw)
+        except ValueError:
+            continue
+        s = r.solve.stats
+        assert s["feasible"], trial
+        if s["proved_optimal"]:
+            proved += 1
+            ex = optimize(solver="milp", **kw)
+            assert ex.solve.optimal
+            assert r.solve.objective == ex.solve.objective, trial
+            assert r.replica_moves <= ex.replica_moves, trial
+    if SOAK > 1:  # CI volume may legitimately prove 0 of 4
+        assert proved >= SOAK // 2
+
+
+def test_agg_bounds_soak(rng):
+    """The aggregated LP/MILP bounds (the jumbo-certifying tier) never
+    undercut the exact optimum — soak companion to
+    ``tests/test_agg_bounds.py`` on the lopsided generator."""
+    trials = 4 * SOAK
+    for trial in range(trials):
+        kw = random_lopsided(rng)
+        try:
+            inst = build_instance(**kw)
+        except ValueError:
+            continue
+        ex = solve_milp(inst)
+        if not ex.optimal:
+            continue
+        for bound in (inst._kept_weight_agg(),
+                      inst._kept_weight_agg(integer=True)):
+            assert bound is not None, trial
+            assert bound >= ex.objective, (
+                f"trial {trial}: aggregated bound {bound} undercuts "
+                f"exact optimum {ex.objective}"
+            )
