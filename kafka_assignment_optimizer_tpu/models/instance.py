@@ -458,7 +458,10 @@ class ProblemInstance:
             # LP cost grows superlinearly in member count; past the
             # aggregation threshold the level-1 LP sticks with the
             # cheaper bound and level 2 switches to the aggregated
-            # formulation (exact; see _kept_weight_agg)
+            # formulation (exact; see _kept_weight_agg). Level 2 also
+            # prefers the aggregated LP whenever symmetry is effective
+            # (generated and steady-state round-robin clusters): same
+            # bound or tighter, at a fraction of the unaggregated cost.
             big = (
                 level >= 1
                 and self._members()[0].size > AGG_MEMBER_THRESHOLD
@@ -472,9 +475,15 @@ class ProblemInstance:
                 if getattr(self, "_bounds_cancelled", False):
                     return memo[1]
                 kept = (
-                    self._kept_weight_agg() if big
-                    else self._kept_weight_lp()
+                    self._kept_weight_agg()
+                    if big or self.agg_effective() else None
                 )
+                if kept is None and not big:
+                    # aggregation unavailable or refused (solver
+                    # failure, deadline): the unaggregated LP is still
+                    # tractable here — don't silently degrade the
+                    # certificate to the level-1 bound
+                    kept = self._kept_weight_lp()
                 memo[2] = memo[1] if kept is None else min(memo[1], kept)
             if level >= 3 and 3 not in memo:
                 if getattr(self, "_bounds_cancelled", False):
@@ -965,6 +974,25 @@ class ProblemInstance:
         )
         self._member_classes_memo = out
         return out
+
+    def agg_effective(self) -> bool:
+        """True when partition symmetry collapses the member space
+        enough that the AGGREGATED kept-replica formulation (LP and
+        MILP) is cheap — the gate for preferring it over the
+        unaggregated LP in the bound ladder and for racing the
+        aggregated plan constructor on any instance, not just the
+        over-threshold ones. Steady-state round-robin clusters (the
+        benchmark family, and real Kafka clusters after a balanced
+        tool pass) collapse by 50-500x; adversarial distinct-weight
+        clusters do not, and this returns False. The gate is a pure
+        collapse RATIO (>= 8x) — no absolute floor — so small or
+        asymmetric instances keep the annealer path (and its CI
+        coverage) instead of degenerating into a host MILP solve."""
+        members = self._members()[0].size
+        if members == 0:
+            return False
+        n_cm = self._member_classes()[3].size
+        return n_cm * 8 <= members
 
     def _kept_weight_agg(self, integer: bool = False,
                          return_solution: bool = False):

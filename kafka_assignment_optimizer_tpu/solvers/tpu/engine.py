@@ -36,6 +36,14 @@ from .seed import greedy_seed
 # at every size (see _defaults).
 _SWEEP_THRESHOLD_PARTS = 512
 
+# how long the solve waits for the LP/MILP plan constructor before
+# starting the annealer (seconds); the "big" value applies past the
+# aggregation threshold, where the constructor is the only path to a
+# certificate and the alternative is a minutes-long first compile.
+# Module-level so tests can pin the race deterministically.
+_CONSTRUCT_WAIT_S = 5.0
+_CONSTRUCT_WAIT_BIG_S = 45.0
+
 
 def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
     """Search-effort defaults for the RESOLVED engine: scale chains with
@@ -143,18 +151,25 @@ def solve_tpu(
     # when balance bands bind, a second worker decodes the kept-replica
     # LP into a plan (solvers.lp_round) — usually the certified global
     # optimum, letting the solve skip annealing (and often compilation)
-    # entirely. Small decommission-style instances skip this: their
-    # caps are slack, the annealer certifies on its own, and the LP
-    # would waste seconds of host CPU. PAST the unaggregated-LP size
-    # (~60k members) the constructor runs regardless: the aggregated
-    # MILP + leader-aware completion reaches optima the annealer's
-    # one-swap moves cannot (the 50k-partition jumbo's exact optimum
-    # needs coordinated leader-cascade placement), and at that scale
-    # it is CHEAPER than one compile of the sweep executable.
+    # entirely. Small ASYMMETRIC decommission-style instances skip
+    # this: their caps are slack, the annealer certifies on its own,
+    # and the LP would waste seconds of host CPU. PAST the
+    # unaggregated-LP size (~60k members) the constructor runs
+    # regardless: the aggregated MILP + leader-aware completion
+    # reaches optima the annealer's one-swap moves cannot (the
+    # 50k-partition jumbo's exact optimum needs coordinated
+    # leader-cascade placement), and at that scale it is CHEAPER than
+    # one compile of the sweep executable.
+    # the constructor also races on any symmetry-collapsible instance
+    # (agg_effective): the aggregated MILP + completion builds the
+    # certified optimum of steady-state clusters — the headline
+    # decommission included — in ~2 s with no compilation, which is
+    # what keeps a cold process inside the 5 s budget.
     lp_fut = (
         _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
         if _caps_bind(inst)
         or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+        or inst.agg_effective()
         else None
     )
     res = _solve_tpu_inner(
@@ -306,31 +321,94 @@ def _solve_tpu_inner(
     lp_fut=None, t_backend=None,
 ) -> SolveResult:
     tight_fut = None
-    # host-side greedy repair: near-feasible, near-min-move warm start
-    a_seed = greedy_seed(inst)
-    assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
-        "seed left unfilled slots"
-    )
+    timed_out = False
+    early_stopped = False
+    certified_a = None
+    constructed = False
+    reseat_tries = 0  # boundary leader-reseat attempts (bounded)
+    rounds_run = 0
+    lp_warm = None
+
+    # LP-construct fast path, FIRST: a certified plan makes annealing —
+    # and with it the greedy seed, the device model arrays and the
+    # schedule — unnecessary. Skipping that setup is ~1.5 s of a cold
+    # process's 5 s budget (the constructor certifies steady-state
+    # instances, the headline decommission included, in ~2 s with zero
+    # compilation). If the worker is not done in time, annealing starts
+    # and the chunk boundaries keep watching for it.
+    if lp_fut is not None:
+        if checkpoint:
+            # fail fast on an unwritable path BEFORE spending solve
+            # time — and before the fast path skips the resume block
+            # below, whose mkdir the end-of-solve ckpt.save relies on
+            from pathlib import Path
+
+            Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
+        budget = _budget_left(t0, time_limit_s)
+        # adaptive wait: past the aggregation threshold — the same
+        # gate that launches the aggregated-MILP constructor above —
+        # the constructor (agg MILP + completion + exact reseat,
+        # ~15-20 s) is far cheaper than the first sweep-executable
+        # compile (minutes), so waiting longer for it is a net win;
+        # below it the snappy cap holds (the aggregated constructor
+        # either lands in ~2 s or the annealer should start)
+        big = inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+        wait_s = _CONSTRUCT_WAIT_BIG_S if big else _CONSTRUCT_WAIT_S
+        try:
+            plan, ok = lp_fut.result(
+                timeout=wait_s if budget is None else min(wait_s, budget)
+            )
+        except Exception:
+            plan, ok = None, False
+        if ok:
+            certified_a = np.asarray(plan, dtype=np.int32)
+            early_stopped = True
+            constructed = True
+        elif plan is not None:
+            # uncertified but complete: candidate warm start, ranked
+            # against the greedy seed below
+            lp_warm = np.asarray(plan, dtype=np.int32)
+
     resumed = False
-    if checkpoint:
-        # fail fast on an unwritable path BEFORE spending solve time
-        from pathlib import Path
+    if certified_a is None:
+        # host-side greedy repair: near-feasible, near-min-move warm
+        # start
+        a_seed = greedy_seed(inst)
+        assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
+            "seed left unfilled slots"
+        )
+        if checkpoint:
+            # fail fast on an unwritable path BEFORE spending solve time
+            from pathlib import Path
 
-        Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
-        # resume (SURVEY.md §5): if a prior solve of this exact instance
-        # left a plan, seed from whichever of {checkpoint, greedy} ranks
-        # higher — the next solve can never regress below the last one
-        a_prev = ckpt.load(checkpoint, inst)
-        if a_prev is not None:
-            def rank(a):
-                pen = sum(inst.violations(a).values())
-                w = inst.preservation_weight(a)
-                return (pen == 0, -pen, w)
+            Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
+            # resume (SURVEY.md §5): if a prior solve of this exact
+            # instance left a plan, seed from whichever of {checkpoint,
+            # greedy} ranks higher — the next solve can never regress
+            # below the last one
+            a_prev = ckpt.load(checkpoint, inst)
+            if a_prev is not None:
+                def rank(a):
+                    pen = sum(inst.violations(a).values())
+                    w = inst.preservation_weight(a)
+                    return (pen == 0, -pen, w)
 
-            if rank(a_prev) >= rank(a_seed):
-                a_seed = a_prev
-                resumed = True
-    m = arrays.from_instance(inst)
+                if rank(a_prev) >= rank(a_seed):
+                    a_seed = a_prev
+                    resumed = True
+        if lp_warm is not None:
+            def _rank(zz):
+                return (
+                    -sum(inst.violations(zz).values()),
+                    inst.preservation_weight(zz),
+                    -inst.move_count(zz),
+                )
+
+            if _rank(lp_warm) > _rank(a_seed):
+                a_seed = lp_warm
+    else:
+        a_seed = certified_a  # never dispatched: the ladder is empty
+    m = arrays.from_instance(inst) if certified_a is None else None
     t_seed = time.perf_counter()
 
     from ...ops.score import moves_batch
@@ -342,7 +420,11 @@ def _solve_tpu_inner(
     mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
     chains_per_device = max(1, batch // n_dev)
-    key = jax.random.PRNGKey(seed)
+    # on the constructed path every device op below is dead weight —
+    # and each tiny dispatch (PRNG key, temperature ladder) is a
+    # compile + round-trip that costs ~1 s over a tunneled TPU in a
+    # cold process, a real bite out of the 5 s budget
+    key = jax.random.PRNGKey(seed) if certified_a is None else None
 
     # the schedule is one geometric ladder cut into equal chunks (one
     # compiled executable — temps is a runtime arg). Between chunks the
@@ -356,32 +438,37 @@ def _solve_tpu_inner(
     # engine restarts its populations from a reseed at each boundary
     # (diversity cost), so it is chunked only when a time limit demands
     # it.
-    temps_full = geometric_temps(t_hi, t_lo, rounds)
-    if engine == "sweep":
-        # chunk length must stay a multiple of the snapshot cadence (8)
-        # and even (exchange-sweep parity) to keep the chunked run
-        # bit-identical to the uncut ladder. Each boundary costs a
-        # dispatch+sync round-trip (~0.1 s over a tunneled TPU), so cut
-        # fine (8 chunks) only when boundaries can pay for themselves:
-        # under a deadline, or at sizes where one chunk dwarfs the
-        # certificate work and an early stop saves minutes.
-        n_chunks = (
-            8 if (time_limit_s is not None or inst.num_parts >= 20_000)
-            else 2
-        )
-        c = 8 * max(1, -(-rounds // (8 * n_chunks)))
-    elif time_limit_s is not None:
-        c = max(1, -(-rounds // 8))
+    if certified_a is not None:
+        chunks = []  # the ladder never runs; build no device schedule
     else:
-        c = rounds  # chain engine, no deadline: one uncut ladder
-    chunks = [temps_full[i:i + c] for i in range(0, rounds, c)]
-    if len(chunks) > 1 and chunks[-1].shape[0] < c:
-        # pad the tail chunk with t_lo so every chunk shares one
-        # compiled shape (extra cold rounds only ever improve)
-        pad = c - chunks[-1].shape[0]
-        chunks[-1] = jnp.concatenate(
-            [chunks[-1], jnp.full((pad,), t_lo, jnp.float32)]
-        )
+        temps_full = geometric_temps(t_hi, t_lo, rounds)
+        if engine == "sweep":
+            # chunk length must stay a multiple of the snapshot cadence
+            # (8) and even (exchange-sweep parity) to keep the chunked
+            # run bit-identical to the uncut ladder. Each boundary
+            # costs a dispatch+sync round-trip (~0.1 s over a tunneled
+            # TPU), so cut fine (8 chunks) only when boundaries can pay
+            # for themselves: under a deadline, or at sizes where one
+            # chunk dwarfs the certificate work and an early stop saves
+            # minutes.
+            n_chunks = (
+                8 if (time_limit_s is not None
+                      or inst.num_parts >= 20_000)
+                else 2
+            )
+            c = 8 * max(1, -(-rounds // (8 * n_chunks)))
+        elif time_limit_s is not None:
+            c = max(1, -(-rounds // 8))
+        else:
+            c = rounds  # chain engine, no deadline: one uncut ladder
+        chunks = [temps_full[i:i + c] for i in range(0, rounds, c)]
+        if len(chunks) > 1 and chunks[-1].shape[0] < c:
+            # pad the tail chunk with t_lo so every chunk shares one
+            # compiled shape (extra cold rounds only ever improve)
+            pad = c - chunks[-1].shape[0]
+            chunks[-1] = jnp.concatenate(
+                [chunks[-1], jnp.full((pad,), t_lo, jnp.float32)]
+            )
     moves_lb = inst.move_lower_bound()  # cheap counting bound
 
     prof = (
@@ -397,54 +484,9 @@ def _solve_tpu_inner(
     scorer = "pallas" if (platform == "tpu" and engine == "sweep") else "xla"
     pallas_fallback: str | None = None
 
-    timed_out = False
-    early_stopped = False
-    certified_a = None
-    constructed = False
-    reseat_tries = 0  # boundary leader-reseat attempts (bounded)
-    rounds_run = 0
-
-    # LP-construct fast path (caps-bind instances): wait briefly for the
-    # constructor worker — a certified plan makes annealing, and on a
-    # cold process the 30s+ compile, unnecessary. If it is not done in
-    # time, annealing starts and the boundaries keep watching for it.
-    if lp_fut is not None:
-        budget = _budget_left(t0, time_limit_s)
-        # adaptive wait: past the aggregation threshold — the same
-        # gate that launches the aggregated-MILP constructor above —
-        # the constructor (agg MILP + completion + exact reseat,
-        # ~15-20 s) is far cheaper than the first sweep-executable
-        # compile (minutes), so waiting longer for it is a net win;
-        # below it the snappy 5 s cap holds (the unaggregated-LP
-        # constructor either lands fast or the annealer should start)
-        big = inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
-        wait_s = 45.0 if big else 5.0
-        try:
-            plan, ok = lp_fut.result(
-                timeout=wait_s if budget is None else min(wait_s, budget)
-            )
-        except Exception:
-            plan, ok = None, False
-        if ok:
-            certified_a = np.asarray(plan, dtype=np.int32)
-            early_stopped = True
-            constructed = True
-        elif plan is not None:
-            # uncertified but complete: warm-start annealing from the
-            # LP structure when it outranks the greedy seed
-            plan = np.asarray(plan, dtype=np.int32)
-
-            def _rank(zz):
-                return (
-                    -sum(inst.violations(zz).values()),
-                    inst.preservation_weight(zz),
-                    -inst.move_count(zz),
-                )
-
-            if _rank(plan) > _rank(a_seed):
-                a_seed = plan
-
-    seed_dev = jnp.asarray(a_seed, jnp.int32)
+    seed_dev = (
+        jnp.asarray(a_seed, jnp.int32) if certified_a is None else None
+    )
     curves = []
     pop_a = pop_k = None
     # sweep engine: full population state (including the per-shard RNG
@@ -455,8 +497,6 @@ def _solve_tpu_inner(
         if engine == "sweep" and certified_a is None
         else None
     )
-    if certified_a is not None:
-        chunks = []
     with prof:
         deadline = None if time_limit_s is None else t0 + time_limit_s
         # chunk 0's duration is compile-inclusive and wildly overstates a

@@ -189,6 +189,32 @@ def test_agg_construct_path_feasible(name, monkeypatch):
     )
 
 
+def test_symmetric_instance_constructs_without_annealing(monkeypatch):
+    """The cold-start fast path (VERDICT r2 item 2): on a
+    symmetry-collapsible instance (every generated benchmark scenario
+    at scale; here the FULL 10k-partition headline, whose collapse only
+    appears at scale) the engine's constructor race wins before any
+    device ladder is built — zero rounds run, plan certified. This is
+    what keeps a cold process under the 5 s headline budget. The
+    no-signal annealer path is pinned by
+    ``test_lp_round.test_no_signal_keeps_annealing_path`` (demo: 19
+    distinct classes of 19 members, agg_effective False)."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu import engine
+
+    # pin the constructor-vs-annealer race: the production 5 s wait is
+    # a latency guard, not the property under test, and a loaded CI
+    # host can lose it despite correct engine behavior
+    monkeypatch.setattr(engine, "_CONSTRUCT_WAIT_S", 120.0)
+    sc, inst = _inst("decommission", smoke=False)
+    assert inst.agg_effective()
+    r = optimize(solver="tpu", seed=0, **sc.kwargs)
+    s = r.solve.stats
+    assert s["constructed"]
+    assert s["proved_optimal"]
+    assert s["rounds_run"] == 0
+    assert s["feasible"]
+
+
 def test_jumbo_full_certified():
     """THE r3 deliverable: the full 512-broker / 50k-partition jumbo
     decommission is solved to a PROVEN global optimum by the aggregated
