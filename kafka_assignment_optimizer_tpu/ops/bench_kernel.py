@@ -55,15 +55,18 @@ def _scorer_roofline(inst, P: int, R: int, n: int, best_s: float,
     specs): per candidate the grid walks every partition tile, fetching
     the candidate rows (int32), the valid mask (bool), and BOTH
     per-(partition, broker) weight tables (int32) — the weight streams
-    dominate at 8*P*B1 bytes/candidate. Blocks with a constant index map
-    (rack one-hot, band rows) stay VMEM-resident and are excluded.
+    dominate at 8*P*B1 bytes/candidate. Blocks with a constant index
+    map (rack one-hot, band rows) stay VMEM-resident and are excluded.
 
     achieved_GBps = floor_bytes / measured_time: a LOWER bound on the
-    attained bandwidth (re-fetches only add traffic), so utilization =
-    achieved/peak is conservative. Utilization far below 1.0 is real
-    headroom — the weight tables are candidate-invariant, and a
-    candidate-minor grid would hold them resident instead of
-    re-streaming them per candidate."""
+    attained bandwidth (re-fetches only add traffic). Interpretation,
+    established by experiment on v5e: utilization ~6% of peak, and a
+    partition-major grid variant that amortizes the weight streams
+    ~70x (plus tile sizes 256-2048) all time IDENTICAL with bit-equal
+    outputs — so the kernel is NOT HBM-bound; the limiter is on-chip
+    (the [TP, R, B1] one-hot materialization in VMEM and its
+    reductions). Reported against HBM peak anyway so every artifact
+    states hardware headroom explicitly, not only a vs-XLA ratio."""
     B1 = inst.num_brokers + 1
     tp = min(256, max(8, -(-P // 8) * 8))
     Pp = -(-P // tp) * tp
@@ -75,8 +78,9 @@ def _scorer_roofline(inst, P: int, R: int, n: int, best_s: float,
     total = bytes_per_cand * n
     peak = _peak_hbm_gbps(device_kind)
     out = {
-        "model": "HBM floor from streamed kernel tiles (weight tables "
-                 "dominate: 8*P*B bytes/candidate)",
+        "model": "HBM floor from streamed kernel tiles; measured "
+                 "limiter is on-chip compute, not HBM (grid-order and "
+                 "tile-size invariant)",
         "bytes_per_candidate": int(bytes_per_cand),
         "achieved_GBps": round(total / best_s / 1e9, 2),
         "device_kind": device_kind,
@@ -163,6 +167,22 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
         pallas_s = _timeit(
             lambda x: score_batch_pallas(x, m, interpret=False), a
         )
+        # a fast wrong kernel must never be reported as a win: the
+        # artifact's speedup is only evidence if the compiled Mosaic
+        # outputs match the XLA oracle integer-for-integer
+        sx = jax.jit(lambda x: score_batch(x, m))(a)
+        sp_ = score_batch_pallas(a, m, interpret=False)
+        import numpy as _np
+
+        parity = bool(
+            (_np.asarray(sx.weight) == _np.asarray(sp_.weight)).all()
+            and (_np.asarray(sx.penalty)
+                 == _np.asarray(sp_.penalty)).all()
+        )
+        report["pallas_parity"] = parity
+        if not parity:
+            report["pallas_error"] = "compiled kernel disagrees with XLA oracle"
+            pallas_s = None
     except Exception as e:  # noqa: BLE001 - lowering failure IS the signal
         report["pallas_error"] = repr(e)[:500]
         pallas_s = None
