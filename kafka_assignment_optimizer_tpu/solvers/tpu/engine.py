@@ -497,6 +497,18 @@ def _solve_tpu_inner(
         if engine == "sweep" and certified_a is None
         else None
     )
+    # overlap the polish compile with the annealing ladder: the
+    # steepest-descent executable costs ~16 s to build at a fresh
+    # shape, and paying that AFTER the last chunk serializes it onto
+    # the critical path of every non-early-stopped solve. The AOT
+    # handle is joined (not just fire-and-forgotten) at final
+    # selection and the compiled object executed directly, so the win
+    # does not depend on the persistent compile cache and the main
+    # thread never races a duplicate compile of the same executable.
+    polish_fut = (
+        _BoundsTask(lambda: polish_jit.lower(m, seed_dev).compile())
+        if chunks else None
+    )
     with prof:
         deadline = None if time_limit_s is None else t0 + time_limit_s
         # chunk 0's duration is compile-inclusive and wildly overstates a
@@ -680,12 +692,26 @@ def _solve_tpu_inner(
         # fewest moves as the tie-break
         primary = jnp.where(s.penalty == 0, s.weight, -s.penalty - 1)
         tied = primary == primary.max()
-        best_a = polish_jit(
-            m,
-            pop_a[jnp.argmax(
-                jnp.where(tied, -moves, jnp.iinfo(jnp.int32).min)
-            )],
-        )
+        cand = pop_a[jnp.argmax(
+            jnp.where(tied, -moves, jnp.iinfo(jnp.int32).min)
+        )]
+        pol = polish_jit
+        if polish_fut is not None:
+            # join the ladder-overlapped compile (free when the ladder
+            # outlasted it, and never slower than starting a second
+            # compile of the same executable here); any AOT mismatch
+            # (sharding, aval) falls back to the jitted path below
+            try:
+                budget = _budget_left(t0, time_limit_s)
+                pol = polish_fut.result(
+                    timeout=60.0 if budget is None else max(budget, 0.0)
+                )
+            except Exception:
+                pol = polish_jit
+        try:
+            best_a = pol(m, cand)
+        except Exception:
+            best_a = polish_jit(m, cand)
         best_a = np.asarray(best_a, dtype=np.int32)
         budget = _budget_left(t0, time_limit_s)
         try:
