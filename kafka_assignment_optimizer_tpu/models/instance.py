@@ -1412,6 +1412,33 @@ class ProblemInstance:
         lb_rack_in = int(np.maximum(self.rack_lo - mk, 0).sum())
         return max(lb_kept, lb_broker_in, lb_rack_in, 0)
 
+    def caps_bind(self) -> bool:
+        """True when balance bands bind against the CURRENT assignment —
+        over-full or under-floor brokers for either replicas or
+        leaderships. These are exactly the instances where (a) local
+        search must trade keeps against bands and plateaus epsilon below
+        the optimum, and (b) the LP-rounding constructor
+        (``solvers.lp_round``) tends to produce a certified optimum
+        outright: scale-outs, leader-skew rebalances, RF changes. A
+        plain decommission triggers neither side."""
+        B = self.num_brokers
+        m_b = (self.w_leader[:, :B] > 0).sum(axis=0)
+        lead = self.a0[:, 0]
+        ok = (
+            (self.rf > 0)
+            & (lead >= 0)
+            & (lead < B)
+            & (self.w_leader[np.arange(self.num_parts),
+                             np.clip(lead, 0, B - 1)] > 0)
+        )
+        lcnt = np.bincount(lead[ok], minlength=B)[:B]
+        return bool(
+            (m_b > self.broker_hi).any()
+            or (m_b < self.broker_lo).any()
+            or (lcnt > self.leader_hi).any()
+            or (lcnt < self.leader_lo).any()
+        )
+
     def certify_optimal(self, a: np.ndarray, allow_tight: bool = True
                         ) -> bool:
         """True iff ``a`` is PROVABLY a global optimum: feasible, its

@@ -47,10 +47,29 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
     aggregated path also serves any instance whose symmetry is
     effective (``agg_effective``): on the 10k-partition headline it
     builds the certified optimum in ~2 s with no compilation, which is
-    what keeps a cold process inside the 5 s budget."""
+    what keeps a cold process inside the 5 s budget.
+
+    The aggregated realization (greedy disaggregation + flow
+    completion) can be LOSSY on instances with binding caps (observed:
+    -14 weight on the 8k-partition scale-out), while the unaggregated
+    exact-vertex decode is historically lossless exactly there — so
+    under the size threshold the caps-bind family tries the exact
+    vertex FIRST, everything else tries the cheap aggregated path
+    first, and either falls through to the other before giving up."""
     members = inst._members()[0].size
     big = members > _instance_mod.AGG_MEMBER_THRESHOLD
-    xi = None
+    lp_first = not big and inst.caps_bind()
+    plan_lp = plan_agg = None
+    if lp_first:
+        plan_lp, vertex_w = _unagg_plan(inst, with_weight=True)
+        if plan_lp is not None and (
+            vertex_w is None
+            or inst.preservation_weight(plan_lp) >= vertex_w
+        ):
+            return plan_lp  # realized the vertex losslessly: optimal
+        # lossy realization (e.g. the blind max-flow completion when
+        # the MCMF kernel is unavailable): let the aggregated path
+        # compete below instead of short-circuiting past it
     if big or inst.agg_effective():
         try:
             agg = inst._kept_weight_agg(integer=True,
@@ -59,35 +78,76 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
             agg = None
         d = _disaggregate(inst, agg) if isinstance(agg, dict) else None
         if d is not None:
-            xi, yi = d["x"], d["y"]
-            quota = agg["z"].astype(np.int64)
-            mrows, mcols = d["mrows"], d["mcols"]
-        elif big:
-            return None  # the unaggregated LP is intractable up there
-    if xi is None:
-        try:
-            sol = inst._kept_weight_lp(return_solution=True)
-        except Exception:
-            return None
-        if not isinstance(sol, dict):
-            return None
-        x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
-        z = np.asarray(sol["z"])
-        mrows, mcols = sol["mrows"], sol["mcols"]
+            plan_agg = _realize(
+                inst, d["x"], d["y"], agg["z"].astype(np.int64),
+                d["mrows"], d["mcols"],
+            )
+            ub = getattr(inst, "_agg_weight_ub", None)
+            if (
+                plan_agg is not None
+                and ub is not None
+                and inst.preservation_weight(plan_agg) >= ub
+            ):
+                return plan_agg  # lossless realization: weight-optimal
+        if big:
+            return plan_agg  # nothing cheaper exists past the threshold
+    if not lp_first:
+        plan_lp, _ = _unagg_plan(inst, with_weight=True)
+    if plan_agg is None:
+        return plan_lp
+    if plan_lp is None:
+        return plan_agg
+    return max(
+        (plan_lp, plan_agg),
+        key=lambda p: (inst.preservation_weight(p),
+                       -inst.move_count(p)),
+    )
 
-        # integral vertex required: kept roles and new-replica quotas
-        # must be whole (transportation structure makes this the
-        # common case)
-        if (
-            np.abs(x - np.rint(x)).max(initial=0) > 1e-6
-            or np.abs(y - np.rint(y)).max(initial=0) > 1e-6
-            or np.abs(z - np.rint(z)).max(initial=0) > 1e-6
-        ):
-            return None
-        xi = np.rint(x).astype(bool)
-        yi = np.rint(y).astype(bool)
-        quota = np.rint(z).astype(np.int64)
 
+def _unagg_plan(inst: ProblemInstance, with_weight: bool = False):
+    """The exact-vertex path: solve the unaggregated kept-replica LP
+    and realize its integral vertex (None on fractional vertices or
+    any realization failure). With ``with_weight`` returns
+    ``(plan, vertex_weight)`` — the kept weight the vertex itself
+    attains, so callers can tell a lossless realization from a
+    degraded one (completion fallbacks can demote kept leaders)."""
+    empty = (None, None) if with_weight else None
+    try:
+        sol = inst._kept_weight_lp(return_solution=True)
+    except Exception:
+        return empty
+    if not isinstance(sol, dict):
+        return empty
+    x, y = np.asarray(sol["x"]), np.asarray(sol["y"])
+    z = np.asarray(sol["z"])
+
+    # integral vertex required: kept roles and new-replica quotas must
+    # be whole (transportation structure makes this the common case)
+    if (
+        np.abs(x - np.rint(x)).max(initial=0) > 1e-6
+        or np.abs(y - np.rint(y)).max(initial=0) > 1e-6
+        or np.abs(z - np.rint(z)).max(initial=0) > 1e-6
+    ):
+        return empty
+    xi = np.rint(x).astype(bool)
+    yi = np.rint(y).astype(bool)
+    plan = _realize(
+        inst, xi, yi, np.rint(z).astype(np.int64),
+        sol["mrows"], sol["mcols"],
+    )
+    if not with_weight:
+        return plan
+    mrows, mcols = sol["mrows"], sol["mcols"]
+    wl = inst.w_leader[mrows, mcols]
+    wf = np.maximum(inst.w_follower[mrows, mcols], 0)
+    vertex_w = int((wf * xi).sum() + (wl * yi).sum())
+    return plan, vertex_w
+
+
+def _realize(inst, xi, yi, quota, mrows, mcols) -> np.ndarray | None:
+    """Place the kept roles, complete the vacancies, reseat leaders —
+    the shared tail of both construct paths. Returns a feasible plan
+    or None."""
     P, R = inst.num_parts, inst.max_rf
     B, K = inst.num_brokers, inst.num_racks
     rf = inst.rf.astype(np.int64)

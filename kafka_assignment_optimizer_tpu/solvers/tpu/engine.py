@@ -238,31 +238,10 @@ def _budget_left(t0: float, time_limit_s: float | None) -> float | None:
 
 
 def _caps_bind(inst: ProblemInstance) -> bool:
-    """True when balance bands bind against the CURRENT assignment —
-    over-full or under-floor brokers for either replicas or leaderships.
-    These are exactly the instances where (a) local search must trade
-    keeps against bands and plateaus epsilon below the optimum, and (b)
-    the LP-rounding constructor (``solvers.lp_round``) tends to produce
-    a certified optimum outright: scale-outs, leader-skew rebalances,
-    RF changes. A plain decommission triggers neither side and keeps
-    its pure annealing fast path."""
-    B = inst.num_brokers
-    m_b = (inst.w_leader[:, :B] > 0).sum(axis=0)
-    lead = inst.a0[:, 0]
-    ok = (
-        (inst.rf > 0)
-        & (lead >= 0)
-        & (lead < B)
-        & (inst.w_leader[np.arange(inst.num_parts),
-                         np.clip(lead, 0, B - 1)] > 0)
-    )
-    lcnt = np.bincount(lead[ok], minlength=B)[:B]
-    return bool(
-        (m_b > inst.broker_hi).any()
-        or (m_b < inst.broker_lo).any()
-        or (lcnt > inst.leader_hi).any()
-        or (lcnt < inst.leader_lo).any()
-    )
+    """Band-binding signal — now a model method (``caps_bind``) shared
+    with the plan constructor's path ordering; thin alias kept for the
+    engine's call sites and tests."""
+    return inst.caps_bind()
 
 
 def _construct_worker(inst: ProblemInstance, bounds_fut) -> tuple:
