@@ -90,6 +90,15 @@ def build_parser() -> argparse.ArgumentParser:
         "and optimality verdict (e.g. score kafka-reassign-partitions "
         "output, README.md:65-91)",
     )
+    ap.add_argument(
+        "--distributed",
+        action="store_true",
+        help="initialize jax's multi-host runtime before solving. Run "
+        "the CLI under a pod launcher on EVERY worker with the same "
+        "input (multi-controller SPMD; cluster auto-detected by jax, "
+        "or JAX_COORDINATOR_ADDRESS). No-op on single-host launches — "
+        "see parallel/distributed.py",
+    )
     return ap
 
 
@@ -147,6 +156,10 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _run(args: argparse.Namespace) -> int:
+    if args.distributed:
+        from .parallel.distributed import init_distributed
+
+        init_distributed()
     text = Path(args.input).read_text() if args.input else sys.stdin.read()
     current = Assignment.from_json(text)
     target_rf = parse_rf(args.rf)

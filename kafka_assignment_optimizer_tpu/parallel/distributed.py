@@ -1,0 +1,85 @@
+"""Multi-host initialization for the distributed backend.
+
+The reference is a single-process batch tool (its only parallelism is
+lp_solve's in-process branch-and-bound, ``/root/reference/README.md:135``);
+the TPU build's search engines shard chain populations over every device
+of a ``jax.sharding.Mesh``. On a multi-host slice (v5e-16+, or any pod
+slice spanning workers) that mesh must cover the GLOBAL device set, which
+requires ``jax.distributed.initialize`` before the first backend touch.
+
+After initialization nothing else changes: ``parallel.mesh.make_mesh``
+builds over ``jax.devices()`` — already global post-init — and the ICI
+migration collectives inside ``shard_map`` (``pmax``/``psum``) are
+compiled by XLA to ride ICI within a slice and DCN across hosts. The
+model arrays are replicated (a few MB); only the few-KB per-shard
+winners cross hosts outside the hot loop.
+
+Execution model: multi-controller SPMD — every worker must run the SAME
+program. That is exactly a pod launcher running the CLI on all workers
+with the same input (``--distributed``); every worker computes the same
+plan and the operator reads worker 0's output. It is NOT the HTTP
+service: independent per-host request streams cannot drive matching
+collectives, so ``serve`` deliberately has no such flag.
+
+Configuration: on cloud TPU pods (GKE/GCE metadata, SLURM, MPI) jax's
+cluster auto-detection — which runs inside ``initialize()`` — finds the
+coordinator, process count and process id on its own; explicit clusters
+pass ``coordinator_address``/``num_processes``/``process_id`` (or set
+``JAX_COORDINATOR_ADDRESS``, the one env var jax itself reads). A
+single-host launch with no cluster environment is detected (jax raises
+``ValueError`` while resolving the spec) and treated as a no-op, so the
+flag is safe to leave on in launch scripts that sometimes run one host.
+Genuine multi-host misconfiguration (bad coordinator, timeout) raises —
+N workers silently solving alone is worse than an error.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Initialize jax's multi-host runtime (idempotent) and return
+    ``(process_index, process_count)``. See the module docstring for
+    the execution model and failure semantics."""
+    import jax
+
+    already = getattr(jax.distributed, "is_initialized", None)
+    if callable(already) and already():
+        return jax.process_index(), jax.process_count()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except ValueError:
+        # no explicit configuration and no cluster environment found by
+        # jax's auto-detection: a single-host launch, run locally
+        print(
+            "[kao] --distributed: no cluster environment detected; "
+            "continuing single-host",
+            file=sys.stderr,
+        )
+    except RuntimeError:
+        # the XLA backend is already initialized (initialize() must
+        # come first). Harmless on a single host — the process was
+        # going to run alone anyway — but an explicit multi-host
+        # request that can no longer be honored must fail loudly, not
+        # degrade into N workers silently solving alone.
+        explicit = any(
+            v is not None
+            for v in (coordinator_address, num_processes, process_id)
+        )
+        if explicit or jax.process_count() > 1:
+            raise
+        print(
+            "[kao] --distributed: XLA backend already initialized; "
+            "continuing single-host",
+            file=sys.stderr,
+        )
+    return jax.process_index(), jax.process_count()

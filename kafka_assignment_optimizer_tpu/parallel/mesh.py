@@ -12,10 +12,15 @@ SURVEY.md §2 "parallelism strategies"). The TPU-native scaling axes
   each shard's worst chain (migration), so devices share discoveries
   without host round-trips. The final plan selection is a host-side argmax
   over the per-shard bests (a few KB).
-- **DCN** would only ever carry embarrassingly parallel multi-host
-  restarts; nothing here requires it.
+- **Multi-host (DCN)**: after ``parallel.distributed.init_distributed``
+  (CLI/serve ``--distributed``) ``jax.devices()`` is the GLOBAL device
+  set, so the same 1-D mesh spans hosts; XLA compiles the migration
+  collectives to ride ICI within a slice and DCN across hosts. Only the
+  once-per-round few-KB winner broadcast ever crosses DCN — the design
+  keeps the hot loop on-chip.
 
-Works identically on one real TPU, a v5e-8 slice, or the CPU test mesh
+Works identically on one real TPU, a v5e-8 slice, a multi-host pod
+slice, or the CPU test mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``, tests/conftest.py).
 """
 
