@@ -208,6 +208,10 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         # True when the engine certified the plan against its LP/flow
         # bounds: provably weight-optimal AND move-optimal
         "proved_optimal": report["proven_optimal"],
+        # constructor evidence: whether the plan was BUILT (aggregated
+        # MILP / exact LP vertex) rather than annealed
+        "constructed": report.get("solver_constructed"),
+        "construct_path": report.get("solver_construct_path"),
         "objective": report["objective_weight"],
         "objective_ub": report["objective_upper_bound"],
         "brokers": report["brokers"],
@@ -255,6 +259,8 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> dict:
         "objective": r.get("objective"),
         "objective_ub": r.get("objective_ub"),
         "engine": r.get("engine"),
+        "constructed": r.get("constructed"),
+        "construct_path": r.get("construct_path"),
     }
 
 

@@ -66,6 +66,7 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
             vertex_w is None
             or inst.preservation_weight(plan_lp) >= vertex_w
         ):
+            inst._construct_path = "lp"
             return plan_lp  # realized the vertex losslessly: optimal
         # lossy realization (e.g. the blind max-flow completion when
         # the MCMF kernel is unavailable): let the aggregated path
@@ -88,20 +89,28 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
                 and ub is not None
                 and inst.preservation_weight(plan_agg) >= ub
             ):
+                inst._construct_path = "agg"
                 return plan_agg  # lossless realization: weight-optimal
         if big:
+            if plan_agg is not None:
+                inst._construct_path = "agg"
             return plan_agg  # nothing cheaper exists past the threshold
     if not lp_first:
         plan_lp, _ = _unagg_plan(inst, with_weight=True)
     if plan_agg is None:
+        if plan_lp is not None:
+            inst._construct_path = "lp"
         return plan_lp
     if plan_lp is None:
+        inst._construct_path = "agg"
         return plan_agg
-    return max(
+    best = max(
         (plan_lp, plan_agg),
         key=lambda p: (inst.preservation_weight(p),
                        -inst.move_count(p)),
     )
+    inst._construct_path = "agg" if best is plan_agg else "lp"
+    return best
 
 
 def _unagg_plan(inst: ProblemInstance, with_weight: bool = False):

@@ -799,8 +799,13 @@ def _solve_tpu_inner(
             "timed_out": timed_out,
             "early_stopped": early_stopped,
             # True when the plan came from the LP-rounding constructor
-            # (solvers.lp_round) rather than annealing
+            # (solvers.lp_round) rather than annealing, and which of
+            # its paths built it (aggregated MILP vs exact LP vertex)
             "constructed": constructed,
+            "construct_path": (
+                getattr(inst, "_construct_path", None)
+                if constructed else None
+            ),
             # best known lower bound: the LP sharpening when it was
             # (lazily) evaluated, else the counting bound
             "moves_lb": (
