@@ -210,9 +210,47 @@ def test_symmetric_instance_constructs_without_annealing(monkeypatch):
     r = optimize(solver="tpu", seed=0, **sc.kwargs)
     s = r.solve.stats
     assert s["constructed"]
+    assert s["construct_path"] == "agg"  # artifact evidence field
     assert s["proved_optimal"]
     assert s["rounds_run"] == 0
     assert s["feasible"]
+
+
+def test_agg_construct_rf_decrease(monkeypatch):
+    """RF-shrink through the aggregated path: classes then have MORE
+    members than the target rf, so the greedy realization must cap
+    per-partition keeps at rf (the uncapped version tripped the
+    rank >= rf guard and silently failed construction). Forced agg
+    (threshold 0) + forced-effective gate on a many-partition cluster
+    whose classes have multiplicity."""
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        Assignment,
+        PartitionAssignment,
+        Topology,
+    )
+    from kafka_assignment_optimizer_tpu.solvers import lp_round
+
+    monkeypatch.setattr(inst_mod, "AGG_MEMBER_THRESHOLD", 0)
+    topo = Topology.from_dict({str(b): f"r{b % 3}" for b in range(9)})
+    # 48 partitions in 2 symmetric groups (classes with multiplicity
+    # 24 — enough for the >=8x agg_effective collapse), current RF=3,
+    # target RF=2 -> every class has 3 members, rf 2
+    parts = [
+        PartitionAssignment("t", p, [(p % 2) * 3, (p % 2) * 3 + 1,
+                                     (p % 2) * 3 + 2])
+        for p in range(48)
+    ]
+    current = Assignment(partitions=parts)
+    inst = build_instance(current, list(range(9)), topo, target_rf=2)
+    assert inst.agg_effective()  # multiplicity 6 over 3-member classes
+    plan = lp_round.construct(inst)
+    assert plan is not None
+    assert inst.is_feasible(plan)
+    assert (plan != inst.num_brokers)[:, :2].all()  # rf honored
+    # quality: a certified-optimal RF shrink keeps 2 of 3 everywhere
+    ex = optimize(solver="milp", current=current,
+                  broker_list=list(range(9)), topology=topo, target_rf=2)
+    assert inst.preservation_weight(plan) == ex.solve.objective
 
 
 def test_jumbo_full_certified():
