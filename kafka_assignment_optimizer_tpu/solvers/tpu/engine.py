@@ -36,6 +36,10 @@ from .seed import greedy_seed
 # at every size (see _defaults).
 _SWEEP_THRESHOLD_PARTS = 512
 
+# tokens for AOT compiles running on daemon threads (GIL-atomic set
+# ops); a long-lived service consults this before jax.clear_caches()
+_PENDING_AOT: set = set()
+
 # how long the solve waits for the LP/MILP plan constructor before
 # starting the annealer (seconds); the "big" value applies past the
 # aggregation threshold, where the constructor is the only path to a
@@ -484,10 +488,18 @@ def _solve_tpu_inner(
     # selection and the compiled object executed directly, so the win
     # does not depend on the persistent compile cache and the main
     # thread never races a duplicate compile of the same executable.
-    polish_fut = (
-        _BoundsTask(lambda: polish_jit.lower(m, seed_dev).compile())
-        if chunks else None
-    )
+    # The _PENDING_AOT token lets a long-lived service know a daemon
+    # compile may still be in flight (a timed-out solve abandons the
+    # join) before it drops jit caches.
+    def _aot_polish():
+        token = object()
+        _PENDING_AOT.add(token)
+        try:
+            return polish_jit.lower(m, seed_dev).compile()
+        finally:
+            _PENDING_AOT.discard(token)
+
+    polish_fut = _BoundsTask(_aot_polish) if chunks else None
     with prof:
         deadline = None if time_limit_s is None else t0 + time_limit_s
         # chunk 0's duration is compile-inclusive and wildly overstates a
