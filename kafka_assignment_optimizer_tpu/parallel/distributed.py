@@ -35,6 +35,7 @@ N workers silently solving alone is worse than an error.
 
 from __future__ import annotations
 
+import os
 import sys
 
 
@@ -51,6 +52,10 @@ def init_distributed(
     already = getattr(jax.distributed, "is_initialized", None)
     if callable(already) and already():
         return jax.process_index(), jax.process_count()
+    explicit = any(
+        v is not None
+        for v in (coordinator_address, num_processes, process_id)
+    ) or bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -58,8 +63,14 @@ def init_distributed(
             process_id=process_id,
         )
     except ValueError:
-        # no explicit configuration and no cluster environment found by
-        # jax's auto-detection: a single-host launch, run locally
+        # A ValueError out of an explicitly configured launch (args or
+        # JAX_COORDINATOR_ADDRESS) is a malformed spec, not "no
+        # cluster": downgrading it would leave N workers silently
+        # solving alone — the exact failure mode this module promises
+        # to surface. Only the truly unconfigured case is a single-host
+        # launch to run locally.
+        if explicit:
+            raise
         print(
             "[kao] --distributed: no cluster environment detected; "
             "continuing single-host",
@@ -71,10 +82,6 @@ def init_distributed(
         # going to run alone anyway — but an explicit multi-host
         # request that can no longer be honored must fail loudly, not
         # degrade into N workers silently solving alone.
-        explicit = any(
-            v is not None
-            for v in (coordinator_address, num_processes, process_id)
-        )
         if explicit or jax.process_count() > 1:
             raise
         print(

@@ -234,6 +234,31 @@ def handle_submit(
             _METRICS["solve_seconds_total"] += dt
             _METRICS["last_solve_seconds"] = dt
             solves = _METRICS["solves_total"]
+        if solves % 64 == 0:
+            # long-lived-process executable bound: a stream of
+            # differently shaped clusters accumulates jitted
+            # executables without limit, and past a few hundred
+            # distinct compiles jaxlib's XLA:CPU compile has been
+            # observed to segfault (soak-found; not memory — see
+            # tests/test_lp_fuzz.py). Dropping the in-process caches
+            # periodically keeps the service in the stable regime;
+            # warm same-shape re-solves refill from the persistent
+            # disk cache at ~cache-load cost. Must run while
+            # _SOLVE_LOCK is still held: under ThreadingHTTPServer a
+            # released lock lets another request start tracing before
+            # the clear lands, and the _PENDING_AOT check would
+            # otherwise race a daemon AOT compile from a timed-out
+            # solve. The inner try swallows clear-time failures so
+            # they can never discard the finished plan.
+            try:
+                from .solvers.tpu.engine import _PENDING_AOT
+
+                if not _PENDING_AOT:
+                    import jax
+
+                    jax.clear_caches()
+            except Exception:
+                pass
     except (ValueError, KeyError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
         raise ApiError(422, f"model rejected inputs: {msg}") from e
@@ -243,27 +268,6 @@ def handle_submit(
         raise ApiError(500, f"solver failed: {e}") from e
     finally:
         _SOLVE_LOCK.release()
-    if solves % 64 == 0:
-        # long-lived-process executable bound: a stream of differently
-        # shaped clusters accumulates jitted executables without
-        # limit, and past a few hundred distinct compiles jaxlib's
-        # XLA:CPU compile has been observed to segfault (soak-found;
-        # not memory — see tests/test_lp_fuzz.py). Dropping the
-        # in-process caches periodically keeps the service in the
-        # stable regime; warm same-shape re-solves refill from the
-        # persistent disk cache at ~cache-load cost. Outside the solve
-        # try-block so a clear-time failure can never discard the
-        # finished plan, and skipped while a daemon AOT compile from a
-        # timed-out solve may still be registering executables.
-        try:
-            from .solvers.tpu.engine import _PENDING_AOT
-
-            if not _PENDING_AOT:
-                import jax
-
-                jax.clear_caches()
-        except Exception:
-            pass
     return {
         "assignment": res.assignment.to_dict(),
         "report": res.report(),

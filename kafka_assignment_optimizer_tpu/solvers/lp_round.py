@@ -302,7 +302,10 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
     - arcs giving a LEADERLESS partition a new replica on a broker with
       leadership headroom (capped per broker by ``lead_quota`` through
       a gateway node) carry cost -1 -> coverage is maximized, so the
-      final exact reseat is not forced to demote kept leaders;
+      final exact reseat is not forced to demote kept leaders; each
+      such candidate also has a parallel cost-0 bypass so a plain
+      placement never consumes lead quota (binding gates must reduce
+      the reward, not the max flow);
     - the first ``broker_lo - kept`` / ``rack_lo - kept`` units into a
       below-floor broker/rack carry cost -1000 -> band deficits are
       filled with absolute priority (a completion that leaves a floor
@@ -356,30 +359,41 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
     rows_f, cols_f = np.nonzero(filled)
     in_part[rows_f, a[rows_f, cols_f]] = True
 
-    # node ids: 0 source | parts | pairs | lead gateways | brokers |
-    # racks | sink
-    o_part, o_pair = 1, 1 + P
-    o_gate = o_pair + U
-    o_brok = o_gate + B
-    o_rack = o_brok + B
-    t = o_rack + K
     # candidate (p, b) edges
     eb_p = np.repeat(pv, qb.size)
     eb_b = np.tile(qb, pv.size)
     pid = pair_of[eb_p * K + rack_of[eb_b]]
     ok_e = (pid >= 0) & ~in_part[eb_p, eb_b]
     eb_p, eb_b, pid = eb_p[ok_e], eb_b[ok_e], pid[ok_e]
-    # lead-channel edges REPLACE the direct edge for that (p, b) pair,
-    # so per-(p, b) uniqueness holds without extra nodes
+    # lead-channel candidates get a per-(p, b) intermediate node with
+    # TWO outgoing arcs: the gated lead arc (cost -1, shares the
+    # broker's lead-quota capacity) AND a parallel cost-0 direct arc.
+    # The intermediate's unit in-capacity keeps per-(p, b) uniqueness,
+    # and the direct arc means a plain placement never consumes lead
+    # quota — without it, binding gates push max flow below the
+    # vacancy count and abort the whole leader-aware completion to the
+    # blind fallback.
     lead_e = leaderless[eb_p] & (lead_quota[eb_b] > 0)
+    n_lead = int(lead_e.sum())
+    # node ids: 0 source | parts | pairs | lead gateways | brokers |
+    # racks | per-(p, b) lead intermediates | sink
+    o_part, o_pair = 1, 1 + P
+    o_gate = o_pair + U
+    o_brok = o_gate + B
+    o_rack = o_brok + B
+    o_mid = o_rack + K
+    t = o_mid + n_lead
     DEFICIT_REWARD = 1000
     b_idx = np.arange(B)
     k_idx = np.arange(K)
+    m_idx = np.arange(n_lead)
     src = [
         np.zeros(pv.size, np.int64),        # s -> p
         o_part + pk_p,                      # p -> (p, k)
         o_pair + pid[~lead_e],              # (p, k) -> b   (plain)
-        o_pair + pid[lead_e],               # (p, k) -> gate (lead)
+        o_pair + pid[lead_e],               # (p, k) -> mid (lead cand)
+        o_mid + m_idx,                      # mid -> gate (lead channel)
+        o_mid + m_idx,                      # mid -> b     (plain bypass)
         o_gate + b_idx,                     # gate -> b
         o_brok + qb,                        # b -> rack: deficit channel
         o_brok + qb,                        # b -> rack: remaining slack
@@ -390,7 +404,9 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
         o_part + pv,
         o_pair + np.arange(U),
         o_brok + eb_b[~lead_e],
+        o_mid + m_idx,
         o_gate + eb_b[lead_e],
+        o_brok + eb_b[lead_e],
         o_brok + b_idx,
         o_rack + rack_of[qb],
         o_rack + rack_of[qb],
@@ -401,7 +417,9 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
         vac[pv],
         np.minimum(rem[pk_p, pk_k], vac[pk_p]),
         np.ones(int((~lead_e).sum()), np.int64),
-        np.ones(int(lead_e.sum()), np.int64),
+        np.ones(n_lead, np.int64),
+        np.ones(n_lead, np.int64),
+        np.ones(n_lead, np.int64),
         np.minimum(lead_quota, cap_b),
         deficit_b[qb],
         (cap_b - deficit_b)[qb],
@@ -412,7 +430,9 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
         np.zeros(pv.size, np.int64),
         np.zeros(U, np.int64),
         np.zeros(int((~lead_e).sum()), np.int64),
-        -np.ones(int(lead_e.sum()), np.int64),
+        np.zeros(n_lead, np.int64),
+        -np.ones(n_lead, np.int64),
+        np.zeros(n_lead, np.int64),
         np.zeros(B, np.int64),
         np.full(qb.size, -DEFICIT_REWARD, np.int64),
         np.zeros(qb.size, np.int64),
@@ -436,7 +456,9 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
     for i in np.flatnonzero(pf):
         out.extend([(int(eb_p[~lead_e][i]), int(eb_b[~lead_e][i]))]
                    * int(pf[i]))
-    lf = arc_flow[n0 + n_plain:n0 + n_plain + int(lead_e.sum())]
+    # a lead candidate is placed iff its (p, k) -> mid arc carries flow
+    # (whichever outgoing channel it took)
+    lf = arc_flow[n0 + n_plain:n0 + n_plain + n_lead]
     for i in np.flatnonzero(lf):
         out.extend([(int(eb_p[lead_e][i]), int(eb_b[lead_e][i]))]
                    * int(lf[i]))

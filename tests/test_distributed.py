@@ -29,6 +29,35 @@ def test_single_host_is_noop(monkeypatch, capsys):
     assert init_distributed() == (idx, cnt)
 
 
+def test_explicit_misconfig_raises(monkeypatch):
+    """A ValueError out of an EXPLICITLY configured launch (args or
+    JAX_COORDINATOR_ADDRESS) is a malformed spec, not 'no cluster' —
+    it must raise rather than let N workers silently solve alone."""
+    import pytest
+
+    import kafka_assignment_optimizer_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False, raising=False
+    )
+
+    def boom(**kw):
+        raise ValueError("malformed spec")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(ValueError):
+        dist.init_distributed(coordinator_address="nonsense:0",
+                              num_processes=2, process_id=0)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "nonsense:0")
+    with pytest.raises(ValueError):
+        dist.init_distributed()
+    # truly unconfigured: same ValueError downgrades to single-host
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    assert dist.init_distributed() == (
+        jax.process_index(), jax.process_count()
+    )
+
+
 def test_mesh_spans_global_devices():
     """make_mesh builds over jax.devices() — the view that becomes
     global after a real distributed init — so multi-host needs no mesh

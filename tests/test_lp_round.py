@@ -69,6 +69,39 @@ def test_construct_never_infeasible_fuzz(rng):
             assert inst.is_feasible(a), (trial, inst.violations(a))
 
 
+def test_mcmf_completion_survives_binding_lead_gates():
+    """Plain placements must not consume lead quota: two leaderless
+    vacancies forced onto one broker with lead_quota 1 must still all
+    place (one through the rewarded lead channel, one through the
+    cost-0 bypass) instead of aborting at max flow 1."""
+    from types import SimpleNamespace
+
+    from kafka_assignment_optimizer_tpu.solvers.lp_round import (
+        _complete_mcmf,
+    )
+
+    B = 2
+    inst = SimpleNamespace(
+        num_brokers=B,
+        num_racks=1,
+        rack_of_broker=np.zeros(B + 1, dtype=np.int32),
+        broker_hi=np.array([2, 0]),
+        broker_lo=np.array([0, 0]),
+        rack_hi=np.array([2]),
+        rack_lo=np.array([0]),
+        part_rack_hi=np.array([2, 2]),
+    )
+    a = np.full((2, 1), B, dtype=np.int32)  # both slots vacant
+    out = _complete_mcmf(
+        inst, a,
+        vac=np.array([1, 1]),
+        leaderless=np.array([True, True]),
+        lead_quota=np.array([1, 0]),
+    )
+    assert out is not None
+    assert sorted(out) == [(0, 0), (1, 0)]
+
+
 def test_engine_uses_constructed_plan():
     """solve_tpu on a caps-bind scenario returns the constructed
     certified plan without running any annealing rounds. Bounds are
