@@ -22,11 +22,13 @@ init in a subprocess under a hard timeout, falls back to
 a child process under a timeout, and ALWAYS prints the one-line JSON —
 on total failure the line carries ``"error"`` and ``vs_baseline: 0.0``.
 
-By default every BASELINE scenario runs (plus the jumbo stretch config)
-and the one stdout JSON line carries a compact ``scenarios`` array —
-(scenario, wall, cold, moves, lb, proved_optimal) per row — so the
-driver artifact evidences the complete results table, not just the
-headline (VERDICT r2 item 3). After the warm headline runs, one more
+By default every BASELINE scenario runs (plus the adversarial and jumbo
+stretch configs) and the one stdout JSON line carries a compact
+``scenarios`` array of positional rows (field order in ``ROW_SCHEMA``),
+so the driver artifact evidences the complete results table, not just
+the headline (VERDICT r2 item 3). The line is kept under
+``STDOUT_BUDGET`` bytes — the driver records only a ~2000-char stdout
+tail (r3 item 1) — with the full per-scenario detail on stderr. After the warm headline runs, one more
 FRESH child process re-solves the headline against the now-populated
 persistent compile cache and reports ``cold_cached_wall_clock_s`` — the
 cold number a second process on the same host actually pays.
@@ -242,33 +244,83 @@ def child_main(args: argparse.Namespace) -> int:
 # --------------------------------------------------------------------------
 
 
-def _compact_row(r: dict | None, name: str, err: str | None) -> dict:
-    """One scenarios[] row: enough to audit the README results table."""
+# the driver records only a ~2000-char TAIL of stdout; a line past that
+# physically loses its leading fields (r3 postmortem: `parsed: null`,
+# headline gone). Budget with margin; over-budget lines shed detail.
+STDOUT_BUDGET = 1600
+
+# scenarios[] rows are positional tuples to stay inside STDOUT_BUDGET;
+# this schema string names the positions for the reader of the artifact
+ROW_SCHEMA = ("scenario,warm_s,cold_s,moves,min_moves_lb,feasible,"
+              "proved_optimal,constructed,engine,path")
+
+
+def _compact_row(r: dict | None, name: str, err: str | None) -> list:
+    """One positional scenarios[] row (see ROW_SCHEMA): enough to audit
+    every README results-table row from the artifact alone."""
     if r is None:
-        return {"scenario": name, "error": (err or "failed")[:300]}
-    return {
-        "scenario": r["scenario"],
-        "wall_clock_s": r["wall_clock_s"],
-        "cold_wall_clock_s": r["cold_wall_clock_s"],
-        "warm": r["warm"],
-        "platform": r.get("platform"),
-        "moves": r["moves"],
-        "min_moves_lb": r["min_moves_lb"],
-        "feasible": r["feasible"],
-        "proved_optimal": r.get("proved_optimal"),
-        "objective": r.get("objective"),
-        "objective_ub": r.get("objective_ub"),
-        "engine": r.get("engine"),
-        "constructed": r.get("constructed"),
-        "construct_path": r.get("construct_path"),
-    }
+        return [name, None, None, None, None, 0, 0, 0, "error",
+                (err or "failed")[:80]]
+    return [
+        r["scenario"],
+        r["wall_clock_s"],
+        r["cold_wall_clock_s"],
+        r["moves"],
+        r["min_moves_lb"],
+        1 if r.get("feasible") else 0,
+        1 if r.get("proved_optimal") else 0,
+        1 if r.get("constructed") else 0,
+        r.get("engine") or "",
+        r.get("construct_path") or "",
+    ]
+
+
+def _compact_kernel(k: dict) -> dict:
+    """3-6 scalars from the kernel micro-bench; the full block (roofline
+    models, propose timings) goes to stderr with the rest of the detail."""
+    if not isinstance(k, dict):
+        return {"error": str(k)[:120]}
+    out: dict = {}
+    if "error" in k:
+        out["error"] = str(k["error"])[:120]
+    if "skipped" in k:
+        out["skipped"] = True
+    for src, dst in (
+        ("pallas_candidates_per_s", "pallas_cand_s"),
+        ("xla_candidates_per_s", "xla_cand_s"),
+        ("pallas_speedup_vs_xla", "speedup"),
+        ("pallas_parity", "parity"),
+        ("sweep_ms", "sweep_ms"),
+    ):
+        if src in k:
+            out[dst] = k[src]
+    roof = k.get("roofline") or {}
+    if "hbm_utilization" in roof:
+        out["hbm_util"] = roof["hbm_utilization"]
+    sweep_roof = k.get("sweep_roofline") or {}
+    if "compute_utilization" in sweep_roof:
+        out["compute_util"] = sweep_roof["compute_utilization"]
+    return out
+
+
+def _print_final(line: dict) -> None:
+    """Emit the ONE stdout line, shedding optional detail if it would
+    overflow the driver's tail capture. Never raises."""
+    for drop in ((), ("kernel",), ("scenarios", "rows_schema")):
+        for key in drop:
+            line.pop(key, None)
+        s = json.dumps(line)
+        if len(s) <= STDOUT_BUDGET:
+            break
+    print(s)
+    print(f"[bench] final stdout line: {len(s)} bytes", file=sys.stderr)
 
 
 def emit(head: dict | None, platform: str, tpu_error: str | None,
          scenario: str, run_error: str | None = None,
-         scenarios: list[dict] | None = None,
+         scenarios: list[list] | None = None,
          cold_cached: float | None = None) -> None:
-    """Print the one-line JSON. Never raises."""
+    """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
         line = {
             "metric": f"{scenario}_wall_clock",
@@ -276,14 +328,17 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
             "unit": "s",
             "vs_baseline": 0.0,
             "platform": platform,
-            "error": run_error or tpu_error or "unknown failure",
+            "error": (run_error or tpu_error or "unknown failure")[:300],
         }
         if tpu_error and run_error:
-            line["tpu_error"] = tpu_error
+            line["tpu_error"] = tpu_error[:200]
         if scenarios:
+            line["rows_schema"] = ROW_SCHEMA
             line["scenarios"] = scenarios
-        print(json.dumps(line))
+        _print_final(line)
         return
+    # the full child report (incl. roofline blocks) is stderr-only
+    print("[bench] DETAIL " + json.dumps(head), file=sys.stderr)
     error = tpu_error
     # quality gate: feasible, and moves at the provable minimum when the
     # bound is known achievable (a fast wrong answer scores nothing)
@@ -302,13 +357,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         "vs_baseline": vs,
         "platform": head.get("platform", platform),
         "cold_wall_clock_s": head.get("cold_wall_clock_s"),
-        "compile_s": head.get("compile_s"),
         "moves": head["moves"],
         "min_moves_lb": head["min_moves_lb"],
         "feasible": head["feasible"],
         "proved_optimal": head.get("proved_optimal"),
         "engine": head.get("engine"),
-        "scorer": head.get("scorer"),
     }
     if cold_cached is not None:
         # a FRESH process re-solving the headline against the populated
@@ -318,14 +371,16 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
     if head.get("pallas_fallback"):
         line["pallas_fallback"] = head["pallas_fallback"]
     if error:
-        line["tpu_error"] = error  # why an accelerator was not used
+        line["tpu_error"] = error[:200]  # why no accelerator was used
     if scenarios:
-        # the full results table inside the driver artifact, one compact
-        # row per BASELINE scenario (VERDICT r2 item 3)
+        # the full results table inside the driver artifact, one
+        # positional row per BASELINE scenario (VERDICT r2 item 3 /
+        # r3 item 1: must fit the tail capture whole)
+        line["rows_schema"] = ROW_SCHEMA
         line["scenarios"] = scenarios
     if "kernel" in head:
-        line["kernel"] = head["kernel"]
-    print(json.dumps(line))
+        line["kernel"] = _compact_kernel(head["kernel"])
+    _print_final(line)
 
 
 def main() -> int:
@@ -379,7 +434,7 @@ def main() -> int:
     else:
         names = [args.scenario]
     head, head_err = None, None
-    rows: list[dict] = []
+    rows: list[list] = []
     cold_cached: float | None = None
     for name in names:
         is_head = name == args.scenario

@@ -31,6 +31,14 @@ def _run(args, env_extra, timeout=300):
     )
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
     assert lines, f"no stdout; stderr tail: {r.stderr[-500:]}"
+    # the driver records only a ~2000-char stdout TAIL: exactly one line,
+    # small enough to survive the capture whole (VERDICT r3 item 1)
+    import bench
+
+    assert len(lines) == 1, f"extra stdout lines: {lines[:-1]}"
+    assert len(lines[-1]) <= bench.STDOUT_BUDGET, (
+        f"stdout line {len(lines[-1])} bytes"
+    )
     return r, json.loads(lines[-1])
 
 
@@ -47,7 +55,8 @@ def test_smoke_demo_prints_parsable_line():
     assert line["vs_baseline"] > 0
     # cold/warm split (VERDICT item 7): cold includes compile, warm does not
     assert line["cold_wall_clock_s"] >= line["value"]
-    assert line["compile_s"] is not None
+    # the full child report (compile split etc.) is stderr-only now
+    assert "[bench] DETAIL " in r.stderr
 
 
 def test_failure_still_prints_parsable_line():
@@ -71,21 +80,25 @@ def test_failure_still_prints_parsable_line():
 def test_default_run_embeds_full_results_table():
     """The driver's default invocation must evidence EVERY scenario in
     the single stdout line (VERDICT r2 item 3): a compact scenarios
-    array plus the fresh-process cold_cached_wall_clock_s probe."""
+    array plus the fresh-process cold_cached_wall_clock_s probe — and
+    the whole line must fit the driver's tail capture (r3 item 1)."""
     from kafka_assignment_optimizer_tpu.utils import gen
 
     r, line = _run(["--smoke"], {"JAX_PLATFORMS": "cpu"}, timeout=900)
     assert r.returncode == 0
-    rows = {row["scenario"]: row for row in line["scenarios"]}
+    schema = line["rows_schema"].split(",")
+    rows = {row[0]: dict(zip(schema, row)) for row in line["scenarios"]}
     assert set(rows) == set(gen.SCENARIOS)
     for name, row in rows.items():
-        assert "error" not in row, f"{name}: {row}"
-        assert row["feasible"] is True
+        assert row["engine"] != "error", f"{name}: {row}"
+        assert row["feasible"] == 1, f"{name}: {row}"
         assert row["moves"] >= row["min_moves_lb"] >= 0
-        assert isinstance(row["wall_clock_s"], float)
-        assert "proved_optimal" in row and "objective" in row
+        assert isinstance(row["warm_s"], float)
+        assert isinstance(row["cold_s"], float)
+        assert row["proved_optimal"] in (0, 1)
+        assert row["constructed"] in (0, 1)
     # the headline row is the same run the headline metric quotes
-    assert rows["decommission"]["wall_clock_s"] == line["value"]
+    assert rows["decommission"]["warm_s"] == line["value"]
     # fresh-process cold probe against the populated compile cache
     assert isinstance(line["cold_cached_wall_clock_s"], float)
     assert line["cold_cached_wall_clock_s"] > 0
