@@ -243,6 +243,193 @@ def leader_only(
     )
 
 
+def _scatter_assignment(
+    broker_ids: list[int],
+    topology: Topology,
+    topic_rf: list[tuple[str, int, int]],
+    rng,
+) -> Assignment:
+    """Exactly balanced but SHUFFLED placement: per-broker totals are
+    floor/ceil(R/B) and every partition is rack-diverse, yet member
+    sets are drawn by seeded shuffle so essentially every partition is
+    its own symmetry class (the opposite of ``balanced_assignment``'s
+    round-robin windows, which collapse 50-500x under
+    ``_member_classes``). ``topic_rf`` is [(topic, n_parts, rf)]."""
+    B = len(broker_ids)
+    rack = {b: topology.rack(b) for b in broker_ids}
+    rfs = [rf for _, n, rf in topic_rf for _ in range(n)]
+    R = sum(rfs)
+    lo, n_hi = R // B, R % B
+    counts = {b: lo for b in broker_ids}
+    # ceil brokers spread rack-interleaved so rack totals stay balanced
+    for b in _rack_interleaved(broker_ids, topology)[:n_hi]:
+        counts[b] += 1
+    supply = [b for b in broker_ids for _ in range(counts[b])]
+    rng.shuffle(supply)
+    starts = [0]
+    for r in rfs:
+        starts.append(starts[-1] + r)
+    # forward repair: ensure each partition's slots are distinct
+    # brokers in distinct racks, swapping offenders with any later slot
+    # that fits (later partitions are untouched regions, so a forward
+    # swap can only be re-examined, never silently corrupted)
+    n_slots = len(supply)
+    for p in range(len(rfs)):
+        s, e = starts[p], starts[p + 1]
+        for j in range(s, e):
+            used_b = set(supply[s:j])
+            used_r = {rack[x] for x in supply[s:j]}
+            if supply[j] not in used_b and rack[supply[j]] not in used_r:
+                continue
+            for k in range(e, n_slots):
+                if (supply[k] not in used_b
+                        and rack[supply[k]] not in used_r):
+                    supply[j], supply[k] = supply[k], supply[j]
+                    break
+            else:
+                # tail starvation: trade with an earlier partition where
+                # both stay valid (rare; seeded, so exercised in tests)
+                if not _backward_slot_trade(
+                    supply, starts, rfs, rack, p, j
+                ):
+                    raise RuntimeError(
+                        "scatter repair failed; change the seed"
+                    )
+    # leaders: greedy least-loaded, then rebalanced into the band that
+    # is valid BOTH before and after any single-broker removal
+    n_p = len(rfs)
+    reps = [supply[starts[p]:starts[p + 1]] for p in range(n_p)]
+    lcnt = {b: 0 for b in broker_ids}
+    leads = []
+    for rr in reps:
+        ld = min(rr, key=lambda b: (lcnt[b], b))
+        lcnt[ld] += 1
+        leads.append(ld)
+    if B > 1:
+        # the surviving-cluster floor is the stricter target, but it is
+        # only reachable when B brokers can all carry it
+        lo_t = n_p // (B - 1)
+        if lo_t * B > n_p:
+            lo_t = n_p // B
+        # ceil(n_p/B) is the stricter (pre-removal) ceiling of the two
+        hi_t = max(-(-n_p // B), lo_t)
+    else:
+        lo_t = hi_t = n_p
+    for bound_pass in ("shed", "fill"):
+        for _ in range(n_p):
+            changed = False
+            for p, rr in enumerate(reps):
+                ld = leads[p]
+                if bound_pass == "shed":
+                    if lcnt[ld] <= hi_t:
+                        continue
+                    cand = [b for b in rr if lcnt[b] < hi_t]
+                else:
+                    if lcnt[ld] <= lo_t:
+                        continue
+                    cand = [b for b in rr if lcnt[b] < lo_t]
+                if not cand:
+                    continue
+                nb = min(cand, key=lambda b: (lcnt[b], b))
+                lcnt[ld] -= 1
+                lcnt[nb] += 1
+                leads[p] = nb
+                changed = True
+            over = any(v > hi_t for v in lcnt.values())
+            under = any(v < lo_t for v in lcnt.values())
+            if bound_pass == "shed" and not over:
+                break
+            if bound_pass == "fill" and not under:
+                break
+            if not changed:
+                raise RuntimeError(
+                    "leader rebalance stalled; change the seed"
+                )
+    parts = []
+    i = 0
+    for topic, n, _rf in topic_rf:
+        for p in range(n):
+            rr = reps[i]
+            ld = leads[i]
+            parts.append(PartitionAssignment(
+                topic=topic, partition=p,
+                replicas=[ld] + [b for b in rr if b != ld],
+            ))
+            i += 1
+    return Assignment(partitions=parts)
+
+
+def _backward_slot_trade(supply, starts, rfs, rack, p, j) -> bool:
+    """Swap ``supply[j]`` with a slot of an earlier partition such that
+    both partitions end up valid. Returns True on success."""
+    s, e = starts[p], starts[p + 1]
+    used_b = set(supply[s:j])
+    used_r = {rack[x] for x in supply[s:j]}
+    for q in range(p):
+        qs, qe = starts[q], starts[q + 1]
+        for k in range(qs, qe):
+            cand = supply[k]
+            if cand in used_b or rack[cand] in used_r:
+                continue
+            q_others = [supply[x] for x in range(qs, qe) if x != k]
+            give = supply[j]
+            if give in q_others:
+                continue
+            if rack[give] in {rack[x] for x in q_others}:
+                continue
+            supply[j], supply[k] = supply[k], supply[j]
+            return True
+    return False
+
+
+def adversarial(
+    n_brokers: int = 256, n_racks: int = 8,
+    n_topics_low: int = 50, n_topics_high: int = 50,
+    parts_per_topic: int = 100, rf_low: int = 2, rf_high: int = 4,
+    seed: int = 7,
+) -> Scenario:
+    """Constructor-proof decommission at headline scale (VERDICT r3
+    item 2): same 256 brokers / 8 racks / 10k partitions as the
+    headline, but with per-partition RF asymmetry (half the topics RF=2,
+    half RF=4) and seeded-shuffled member sets, so every partition is
+    its own symmetry class. ``agg_effective()`` is False (the
+    aggregated MILP refuses), caps stay slack (no LP constructor race:
+    the default totals keep floor/ceil(R/B) unchanged by the removal),
+    and the TPU sweep annealer has to close to the bound ladder
+    on-chip — this row is the at-scale proof of the search engine the
+    framework is named for, not of the host constructor."""
+    import numpy as _np
+
+    all_brokers = list(range(n_brokers))
+    remove = n_brokers - 1
+    topo = _mod_topology(all_brokers, n_racks)
+    topic_rf = (
+        [(f"lo{i}", parts_per_topic, rf_low)
+         for i in range(n_topics_low)]
+        + [(f"hi{i}", parts_per_topic, rf_high)
+           for i in range(n_topics_high)]
+    )
+    current = _scatter_assignment(
+        all_brokers, topo, topic_rf, _np.random.default_rng(seed)
+    )
+    lb = sum(
+        1 for p in current.partitions for b in p.replicas if b == remove
+    )
+    return Scenario(
+        name="adversarial",
+        current=current,
+        broker_list=[b for b in all_brokers if b != remove],
+        topology=topo,
+        min_moves_lb=lb,
+        lb_tight=True,
+        notes=(
+            f"shuffled mixed-RF decommission of broker {remove} "
+            f"({lb} replicas): every partition its own symmetry class, "
+            "caps slack -> annealer must close to the bound on-chip"
+        ),
+    )
+
+
 def jumbo(
     n_brokers: int = 512, n_racks: int = 16,
     n_topics: int = 250, parts_per_topic: int = 200, rf: int = 3,
@@ -269,6 +456,7 @@ SCENARIOS = {
     "decommission": decommission,
     "rf_change": rf_change,
     "leader_only": leader_only,
+    "adversarial": adversarial,
     "jumbo": jumbo,
 }
 
@@ -281,5 +469,7 @@ SMOKE_KWARGS = {
     "decommission": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
     "rf_change": dict(n_brokers=16, n_topics=4, parts_per_topic=25),
     "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
+    "adversarial": dict(n_brokers=32, n_topics_low=11, n_topics_high=9,
+                        parts_per_topic=10),
     "jumbo": dict(n_brokers=48, n_topics=10, parts_per_topic=40),
 }
