@@ -24,8 +24,11 @@ collectives, so ``serve`` deliberately has no such flag.
 Configuration: on cloud TPU pods (GKE/GCE metadata, SLURM, MPI) jax's
 cluster auto-detection — which runs inside ``initialize()`` — finds the
 coordinator, process count and process id on its own; explicit clusters
-pass ``coordinator_address``/``num_processes``/``process_id`` (or set
-``JAX_COORDINATOR_ADDRESS``, the one env var jax itself reads). A
+pass ``coordinator_address``/``num_processes``/``process_id``, or export
+``JAX_COORDINATOR_ADDRESS`` (the env var jax itself reads) plus
+``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` (read here — jax's cluster
+detection has no generic env-var cluster, and a pod launcher that can
+export three variables should not need a SLURM/MPI environment). A
 single-host launch with no cluster environment is detected (jax raises
 ``ValueError`` while resolving the spec) and treated as a no-op, so the
 flag is safe to leave on in launch scripts that sometimes run one host.
@@ -52,6 +55,13 @@ def init_distributed(
     already = getattr(jax.distributed, "is_initialized", None)
     if callable(already) and already():
         return jax.process_index(), jax.process_count()
+    # env-var cluster: jax reads JAX_COORDINATOR_ADDRESS itself, but
+    # has no generic env detection for the process count/id — accept
+    # the two companions here so a plain launcher can form a cluster
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     explicit = any(
         v is not None
         for v in (coordinator_address, num_processes, process_id)

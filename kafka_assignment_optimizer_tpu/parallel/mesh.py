@@ -235,9 +235,22 @@ def solve_on_mesh(
     return fn(m, a_seed, keys, temps)
 
 
+def fetch_global(x):
+    """``device_get`` that also works under multi-controller SPMD: a
+    global array sharded over a multi-process mesh spans devices this
+    process cannot address, so it must be allgathered to every host
+    first (a few hundred KB of per-shard winners, outside the hot
+    loop). Single-process — the common case — stays a plain transfer."""
+    if jax.process_count() == 1:
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+
+    return jax.device_get(multihost_utils.process_allgather(x, tiled=True))
+
+
 def best_of(best_a, best_k, curve=None):
     """Host-side argmax over the per-shard winners (the final cross-shard
     reduce — a few KB)."""
-    best_a, best_k = jax.device_get((best_a, best_k))
+    best_a, best_k = fetch_global((best_a, best_k))
     top = int(np.argmax(best_k))
     return best_a[top], int(best_k[top])

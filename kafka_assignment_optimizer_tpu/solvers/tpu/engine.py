@@ -169,18 +169,30 @@ def solve_tpu(
     # certified optimum of steady-state clusters — the headline
     # decommission included — in ~2 s with no compilation, which is
     # what keeps a cold process inside the 5 s budget.
+    # multi-controller SPMD: every worker must make IDENTICAL decisions
+    # in front of every collective. Host-side races (the constructor
+    # worker, timed boundary certification, wall-clock chunk breaks)
+    # resolve at per-process times and would let one worker skip or
+    # exit the ladder while another issues the next collective —
+    # a pod-wide deadlock. Under multi-process the solve therefore runs
+    # the full deterministic ladder with no host-race shortcuts; the
+    # final certification (same LP on every host) stays.
+    multi = jax.process_count() > 1
     lp_fut = (
         _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
-        if _caps_bind(inst)
-        or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
-        or inst.agg_effective()
+        if not multi
+        and (
+            _caps_bind(inst)
+            or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+            or inst.agg_effective()
+        )
         else None
     )
     res = _solve_tpu_inner(
         inst, seed, batch, rounds, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
         platform, d, steps_per_round_ignored, t0, bounds_fut,
-        cert_min_savings_s, lp_fut, t_backend,
+        cert_min_savings_s, lp_fut, t_backend, multi,
     )
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
@@ -194,7 +206,10 @@ def solve_tpu(
         and engine_arg is None
         and res.stats["engine"] == "sweep"
         and inst.num_parts < _SWEEP_THRESHOLD_PARTS
-        and (time_limit_s is None
+        # SPMD: workers must agree on retrying; the inner solve ignores
+        # the deadline under multi anyway, so only the data-determined
+        # conditions above (identical on every worker) may decide
+        and (multi or time_limit_s is None
              or _budget_left(t0, time_limit_s) > 0)
     ):
         remaining = (
@@ -301,7 +316,7 @@ def _solve_tpu_inner(
     inst, seed, batch, rounds, steps_per_round, t_hi, t_lo, n_devices,
     engine, checkpoint, profile_dir, time_limit_s, platform, d,
     steps_per_round_ignored, t0, bounds_fut, cert_min_savings_s=1.0,
-    lp_fut=None, t_backend=None,
+    lp_fut=None, t_backend=None, multi=False,
 ) -> SolveResult:
     tight_fut = None
     timed_out = False
@@ -311,6 +326,14 @@ def _solve_tpu_inner(
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
     rounds_run = 0
     lp_warm = None
+    # multi-controller SPMD (see solve_tpu): per-process wall-clock
+    # budgets would let workers diverge — in front of collectives
+    # (deadlock) or at the final bound joins (disagreeing plans) — so
+    # the deadline is disabled; the requested value still lands in
+    # stats for the operator to see it was not enforced.
+    time_limit_req = time_limit_s
+    if multi:
+        time_limit_s = None
 
     # LP-construct fast path, FIRST: a certified plan makes annealing —
     # and with it the greedy seed, the device model arrays and the
@@ -396,7 +419,12 @@ def _solve_tpu_inner(
 
     from ...ops.score import moves_batch
     from ...ops.score_pallas import score_batch_auto
-    from ...parallel.mesh import init_sweep_state, make_mesh, solve_on_mesh
+    from ...parallel.mesh import (
+        fetch_global,
+        init_sweep_state,
+        make_mesh,
+        solve_on_mesh,
+    )
     from .arrays import geometric_temps
     from .polish import polish_jit
 
@@ -560,7 +588,7 @@ def _solve_tpu_inner(
                     else min(warm_chunk_s, chunk_s)
                 )
             rounds_run += temps.shape[0]
-            curves.append(np.asarray(jax.device_get(curve)))
+            curves.append(np.asarray(fetch_global(curve)))
             if i + 1 < len(chunks):
                 # a finished constructor worker short-circuits the rest
                 # of the ladder with its certified plan
@@ -595,12 +623,15 @@ def _solve_tpu_inner(
                 est_chunk_s = warm_chunk_s or chunk_s
                 remaining_s = (len(chunks) - i - 1) * est_chunk_s
                 do_cert = (
-                    remaining_s > cert_min_savings_s
+                    not multi
+                    and remaining_s > cert_min_savings_s
                     and bounds_fut.done()
                 )
                 if engine != "sweep" or do_cert:
-                    pa = np.asarray(jax.device_get(pop_a))
-                    pk = np.asarray(jax.device_get(pop_k))
+                    pa, pk = (
+                        np.asarray(x)
+                        for x in fetch_global((pop_a, pop_k))
+                    )
                     # test ONLY the top-ranked shard winner: the key
                     # ranks by weight, so a lower-ranked candidate
                     # cannot pass a weight bound the top one failed,
@@ -675,7 +706,7 @@ def _solve_tpu_inner(
         # polish. pop_a comes back mesh-sharded; gather it to one device
         # first (it is n_dev candidates, a few hundred KB) — Mosaic
         # kernels cannot be auto-partitioned.
-        pop_a = jnp.asarray(jax.device_get(pop_a))
+        pop_a = jnp.asarray(fetch_global(pop_a))
         s = score_batch_auto(pop_a, m)
         moves = moves_batch(pop_a, m)
         # lexicographic in two int32-safe stages (a combined key would
@@ -828,7 +859,7 @@ def _solve_tpu_inner(
             # present only when the lazy LP bound was actually evaluated
             "weight_ub": inst.best_known_weight_ub(),
             "proved_optimal": proved_optimal,
-            "time_limit_s": time_limit_s,
+            "time_limit_s": time_limit_req,
             "steps_per_round": steps_per_round,
             "steps_per_round_ignored": steps_per_round_ignored,
             "scorer": scorer,
