@@ -48,6 +48,14 @@ _PENDING_AOT: set = set()
 _CONSTRUCT_WAIT_S = 5.0
 _CONSTRUCT_WAIT_BIG_S = 45.0
 
+# tiny-instance exact race (VERDICT r3 item 7): below these sizes the
+# exact MILP solves in milliseconds, so a DEFAULTED solve races it like
+# the LP constructor and a cold demo-sized request returns a certified
+# optimum without compiling or touching the device. Explicit engine /
+# budget knobs opt out — a caller tuning the search wants the search.
+_EXACT_RACE_PARTS = 64
+_EXACT_RACE_VARS = 20_000  # 2 * brokers * partitions, the MILP var count
+
 
 def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
     """Search-effort defaults for the RESOLVED engine: scale chains with
@@ -118,28 +126,15 @@ def solve_tpu(
     # bound workers at its return; this solve gets a fresh escalation
     inst._bounds_cancelled = False
     enable_compile_cache()
-    platform = ensure_backend()
-    t_backend = time.perf_counter()  # TPU client init can cost seconds
+    # backend init costs ~5 s over a tunneled TPU and the host-side
+    # workers below (bounds prefetch, plan constructor) don't need the
+    # device at all — run the client init on its own daemon thread so
+    # it overlaps them instead of serializing in front (on the
+    # constructed path the device may end up never used at all)
+    backend_fut = _BoundsTask(ensure_backend)
     # pre-default arguments: the fallback retry must forward what the
     # USER asked for, not this engine's resolved defaults
     engine_arg, batch_arg, t_hi_arg, t_lo_arg = engine, batch, t_hi, t_lo
-    d = _defaults(inst, platform, engine)
-    engine = d["engine"]
-    batch = batch or d["batch"]
-    rounds = rounds or sweeps or d["rounds"]
-    steps_per_round_ignored = False
-    steps_per_round = steps_per_round or d["steps_per_round"]
-    if engine == "sweep" and steps_per_round != 1:
-        # the sweep engine has no inner step loop: its sequential budget
-        # is `rounds` sweeps, each touching every partition once. An
-        # explicit user override has no effect — say so in stats instead
-        # of silently eating the knob.
-        steps_per_round_ignored = True
-        steps_per_round = 1
-    if t_hi is None:
-        t_hi = 2.0 if engine == "sweep" else 2.5
-    if t_lo is None:
-        t_lo = 0.02 if engine == "sweep" else 0.05
 
     # the optimality bounds solve a max-flow + small LP (~1.5 s total at
     # 10k partitions): PREFETCH them on a DAEMON host thread that
@@ -177,22 +172,32 @@ def solve_tpu(
     # a pod-wide deadlock. Under multi-process the solve therefore runs
     # the full deterministic ladder with no host-race shortcuts; the
     # final certification (same LP on every host) stays.
-    multi = jax.process_count() > 1
-    lp_fut = (
-        _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
-        if not multi
-        and (
-            _caps_bind(inst)
-            or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
-            or inst.agg_effective()
-        )
-        else None
+    multi = _process_count() > 1
+    knobs_set = any(
+        v is not None
+        for v in (engine, batch, rounds, sweeps, steps_per_round,
+                  t_hi, t_lo)
     )
+    if not multi and (
+        _caps_bind(inst)
+        or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+        or inst.agg_effective()
+    ):
+        lp_fut = _BoundsTask(lambda: _construct_worker(inst, bounds_fut))
+    elif (
+        not multi
+        and not knobs_set
+        and inst.num_parts <= _EXACT_RACE_PARTS
+        and 2 * inst.num_brokers * inst.num_parts <= _EXACT_RACE_VARS
+    ):
+        lp_fut = _BoundsTask(lambda: _exact_worker(inst, bounds_fut))
+    else:
+        lp_fut = None
     res = _solve_tpu_inner(
-        inst, seed, batch, rounds, steps_per_round, t_hi, t_lo,
+        inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
-        platform, d, steps_per_round_ignored, t0, bounds_fut,
-        cert_min_savings_s, lp_fut, t_backend, multi,
+        backend_fut, t0, bounds_fut,
+        cert_min_savings_s, lp_fut, multi,
     )
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
@@ -256,6 +261,17 @@ def _budget_left(t0: float, time_limit_s: float | None) -> float | None:
     return max(0.0, t0 + time_limit_s - time.perf_counter())
 
 
+def _process_count() -> int:
+    """``jax.process_count()`` without forcing backend init: an
+    uninitialized ``jax.distributed`` means single-process by
+    definition, and asking jax directly would serialize the multi-second
+    TPU client init that ``solve_tpu`` deliberately runs on a thread."""
+    init = getattr(jax.distributed, "is_initialized", None)
+    if callable(init) and not init():
+        return 1
+    return jax.process_count()
+
+
 def _caps_bind(inst: ProblemInstance) -> bool:
     """Band-binding signal — now a model method (``caps_bind``) shared
     with the plan constructor's path ordering; thin alias kept for the
@@ -277,6 +293,37 @@ def _construct_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     if plan is None:
         return None, False
     return plan, inst.certify_optimal(plan)
+
+
+def _exact_worker(inst: ProblemInstance, bounds_fut) -> tuple:
+    """Tiny-instance race body: solve the exact MILP (milliseconds at
+    P <= 64) and certify its plan. The proven MILP optimum is itself a
+    valid weight upper bound on every feasible plan, so it is recorded
+    the same way the aggregated constructor records its optimum —
+    certify_optimal then needs no LP ladder, only the move bound.
+    Joins the bounds prefetch first (same reason as _construct_worker:
+    certify's move bound is memoized there; two threads must not race
+    the same computations). Time-limited: losing the race must not
+    leave an unkillable HiGHS solve grinding host CPU into the next
+    request (the failure class ADVICE r2's cancel closed for bounds)."""
+    try:
+        bounds_fut.result()
+    except Exception:
+        pass
+    from ..milp import solve_milp
+
+    r = solve_milp(inst, time_limit_s=2 * _CONSTRUCT_WAIT_S)
+    if not r.optimal or r.a is None:
+        return None, False
+    plan = np.asarray(r.a, dtype=np.int32)
+    if r.objective is not None:
+        inst._agg_weight_ub = int(r.objective)
+    if inst.certify_optimal(plan):
+        inst._construct_path = "milp"
+        return plan, True
+    # weight-optimal but not provably move-minimal: still a strong
+    # warm start for the annealer
+    return plan, False
 
 
 class _BoundsTask:
@@ -313,10 +360,10 @@ class _BoundsTask:
 
 
 def _solve_tpu_inner(
-    inst, seed, batch, rounds, steps_per_round, t_hi, t_lo, n_devices,
-    engine, checkpoint, profile_dir, time_limit_s, platform, d,
-    steps_per_round_ignored, t0, bounds_fut, cert_min_savings_s=1.0,
-    lp_fut=None, t_backend=None, multi=False,
+    inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
+    n_devices, engine, checkpoint, profile_dir, time_limit_s,
+    backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
+    lp_fut=None, multi=False,
 ) -> SolveResult:
     tight_fut = None
     timed_out = False
@@ -375,6 +422,47 @@ def _solve_tpu_inner(
             # against the greedy seed below
             lp_warm = np.asarray(plan, dtype=np.int32)
 
+    # platform + search-effort defaults are resolved ONLY when the
+    # search will actually run: on the constructed path the backend may
+    # still be initializing on its thread (or never be needed at all) —
+    # joining it would put the multi-second TPU client init back on the
+    # critical path the constructor race exists to avoid.
+    if certified_a is None:
+        platform = backend_fut.result()
+        t_backend = time.perf_counter()
+        d = _defaults(inst, platform, engine)
+        engine = d["engine"]
+        batch = batch or d["batch"]
+        rounds = rounds or sweeps or d["rounds"]
+        steps_per_round_ignored = False
+        steps_per_round = steps_per_round or d["steps_per_round"]
+        if engine == "sweep" and steps_per_round != 1:
+            # the sweep engine has no inner step loop: its sequential
+            # budget is `rounds` sweeps, each touching every partition
+            # once. An explicit user override has no effect — say so in
+            # stats instead of silently eating the knob.
+            steps_per_round_ignored = True
+            steps_per_round = 1
+        if t_hi is None:
+            t_hi = 2.0 if engine == "sweep" else 2.5
+        if t_lo is None:
+            t_lo = 0.02 if engine == "sweep" else 0.05
+    else:
+        # a dead device must not fail a solve that never needs one:
+        # ensure_backend's stored exception (dead tunnel, plugin error)
+        # only matters on the search path
+        try:
+            platform = (
+                backend_fut.result(timeout=0.0) if backend_fut.done()
+                else "host"
+            )
+        except Exception:
+            platform = "host"
+        t_backend = None
+        engine = "construct"
+        batch = rounds = steps_per_round = 0
+        steps_per_round_ignored = False
+
     resumed = False
     if certified_a is None:
         # host-side greedy repair: near-feasible, near-min-move warm
@@ -417,25 +505,31 @@ def _solve_tpu_inner(
     m = arrays.from_instance(inst) if certified_a is None else None
     t_seed = time.perf_counter()
 
-    from ...ops.score import moves_batch
-    from ...ops.score_pallas import score_batch_auto
-    from ...parallel.mesh import (
-        fetch_global,
-        init_sweep_state,
-        make_mesh,
-        solve_on_mesh,
-    )
-    from .arrays import geometric_temps
-    from .polish import polish_jit
+    if certified_a is None:
+        from ...ops.score import moves_batch
+        from ...ops.score_pallas import score_batch_auto
+        from ...parallel.mesh import (
+            fetch_global,
+            init_sweep_state,
+            make_mesh,
+            solve_on_mesh,
+        )
+        from .arrays import geometric_temps
+        from .polish import polish_jit
 
-    mesh = make_mesh(n_devices)
-    n_dev = mesh.devices.size
-    chains_per_device = max(1, batch // n_dev)
-    # on the constructed path every device op below is dead weight —
-    # and each tiny dispatch (PRNG key, temperature ladder) is a
-    # compile + round-trip that costs ~1 s over a tunneled TPU in a
-    # cold process, a real bite out of the 5 s budget
-    key = jax.random.PRNGKey(seed) if certified_a is None else None
+        mesh = make_mesh(n_devices)
+        n_dev = mesh.devices.size
+        chains_per_device = max(1, batch // n_dev)
+        key = jax.random.PRNGKey(seed)
+    else:
+        # the constructed path touches no device at all: mesh creation,
+        # PRNG keys and the jax module imports each cost a dispatch /
+        # client round-trip (~1 s each over a tunneled TPU in a cold
+        # process) for machinery the empty ladder below never uses
+        mesh = None
+        n_dev = 0
+        chains_per_device = 0
+        key = None
 
     # the schedule is one geometric ladder cut into equal chunks (one
     # compiled executable — temps is a runtime arg). Between chunks the
@@ -508,6 +602,8 @@ def _solve_tpu_inner(
         if engine == "sweep" and certified_a is None
         else None
     )
+    if not chunks:
+        polish_jit = None  # device path never imported (certified)
     # overlap the polish compile with the annealing ladder: the
     # steepest-descent executable costs ~16 s to build at a fresh
     # shape, and paying that AFTER the last chunk serializes it onto

@@ -128,13 +128,18 @@ def test_engine_proves_optimality():
     assert s["moves"] == s["moves_lb"]
 
 
-def test_engine_early_stops_with_proof():
+def test_engine_early_stops_with_proof(monkeypatch):
     """With the bounds already memoized (prewarmed), the boundary
     certificate fires deterministically and the engine stops early. (In
     production the bounds prefetch races the ladder — the non-blocking
-    check just makes early-stop opportunistic.)"""
-    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu
+    check just makes early-stop opportunistic.) The plan CONSTRUCTOR is
+    neutralized: if it wins the race the ladder never starts and this
+    test would pass vacuously without exercising the boundary check."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
 
+    monkeypatch.setattr(
+        eng, "_construct_worker", lambda inst, bounds_fut: (None, False)
+    )
     sc, inst = _inst("decommission")
     inst.move_lower_bound_exact()
     inst.weight_upper_bound()
@@ -142,7 +147,8 @@ def test_engine_early_stops_with_proof():
     # the chain engine runs one uncut ladder unless a deadline forces
     # chunking. cert_min_savings_s=0 disables the "is stopping early
     # even worth it" economics so the check is deterministic.
-    res = solve_tpu(inst, seed=0, engine="sweep", cert_min_savings_s=0.0)
+    res = eng.solve_tpu(inst, seed=0, engine="sweep",
+                        cert_min_savings_s=0.0)
     s = res.stats
     assert s["feasible"]
     assert s["proved_optimal"]

@@ -116,7 +116,14 @@ def test_sweep_engine_leader_only_zero_moves():
 
 
 def test_auto_engine_selection_by_size(rng, monkeypatch):
-    """Below the threshold the chain engine runs; defaults report it."""
+    """Below the threshold the chain engine runs; defaults report it.
+    The constructor is neutralized — a constructed plan reports
+    engine='construct', and this test pins the SEARCH default."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
+
+    monkeypatch.setattr(
+        eng, "_construct_worker", lambda inst, bounds_fut: (None, False)
+    )
     current, brokers, topo = random_cluster(rng, 8, 10, 2, 2, drop=0)
     res = optimize(current, brokers, topo, solver="tpu",
                    batch=8, rounds=4, steps_per_round=50)
