@@ -403,10 +403,26 @@ struct Solver {
         else
           act_lo[r] -= c;
       }
-      if (act_lo[r] > m.rows[r].hi || act_hi[r] < m.rows[r].lo) ok = false;
+      if (act_lo[r] > m.rows[r].hi || act_hi[r] < m.rows[r].lo) {
+        if (ok) fail_row = r;  // first culprit: restart weighting
+        ok = false;
+      }
       dirty.push_back(r);
     }
     return ok;
+  }
+
+  // conflict weighting (dom/wdeg-lite): rows that keep killing dives
+  // rise to the front of later restarts' demand order
+  int fail_row = -1;
+  std::vector<uint64_t> row_weight;
+
+  void bump_fail_row() {
+    if (fail_row < 0) return;
+    if (row_weight.size() != m.rows.size())
+      row_weight.assign(m.rows.size(), 0);
+    ++row_weight[fail_row];
+    fail_row = -1;
   }
 
   void undo(Trail &tr) {
@@ -496,6 +512,15 @@ struct Solver {
   bool first_feasible_only = false;
   bool phase_aborted = false;
   uint64_t node_cap = 0;
+  std::vector<int> feas_rows;  // demand rows (lo > 0), variant order
+  uint64_t rng_state = 1;
+
+  uint64_t rnd() {  // splitmix64: deterministic per-variant stream
+    uint64_t z = (rng_state += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
 
   void recompute_suffix() {
     for (int i = n - 1; i >= 0; --i)
@@ -504,29 +529,137 @@ struct Solver {
   }
 
   // Feasibility-first variable order: complete one demand row (lo > 0
-  // — the RF / one-leader equalities of this model family) at a time,
-  // in file order. Propagation then keeps each dive's backtracking
-  // local to a partition block. The objective-major order is the right
-  // one for PRUNING but can thrash for hours on tight capacity bands
-  // before reaching ANY feasible leaf (fuzz-found: RF=4 clusters with
+  // — the RF / one-leader equalities of this model family) at a time.
+  // Propagation then keeps each dive's backtracking local to a
+  // partition block. The objective-major order is the right one for
+  // PRUNING but can thrash for hours on tight capacity bands before
+  // reaching ANY feasible leaf (fuzz-found: RF=4 clusters with
   // 1-broker racks gave rc=7 at 120 s while the incumbent-seeded
   // search proves optimality in milliseconds).
-  void use_feasibility_order() {
+  //
+  // A single row order is not enough on extreme exact-band instances
+  // (perfect-packing feasibility problems the generator produces):
+  // whichever fixed order is chosen, some instance packs the early
+  // rows in a way no completion can finish, and chronological
+  // backtracking cannot climb back out within any node budget. run()
+  // therefore tries a LADDER of orders until one lands an incumbent:
+  //   variant 0: demand rows in file order (fast common case)
+  //   variant 1: tightest band first (hi-lo asc; exact rows lead)
+  //   variant 2: widest demand first (reverse of 1)
+  //   variant 3+: deterministic shuffles (splitmix64-seeded)
+  void use_feasibility_order(int variant = 0) {
+    std::vector<int> rows_idx;
+    for (size_t r = 0; r < m.rows.size(); ++r)
+      if (m.rows[r].lo > 0) rows_idx.push_back((int)r);
+    if (variant == -1 && !row_weight.empty()) {
+      // conflict-weighted: the rows that killed previous dives lead
+      std::stable_sort(rows_idx.begin(), rows_idx.end(),
+                       [&](int a, int b) {
+                         if (row_weight[a] != row_weight[b])
+                           return row_weight[a] > row_weight[b];
+                         return (m.rows[a].hi - m.rows[a].lo) <
+                                (m.rows[b].hi - m.rows[b].lo);
+                       });
+    } else if (variant == 1 || variant == 2) {
+      std::stable_sort(rows_idx.begin(), rows_idx.end(),
+                       [&](int a, int b) {
+                         int64_t sa = m.rows[a].hi - m.rows[a].lo;
+                         int64_t sb = m.rows[b].hi - m.rows[b].lo;
+                         if (sa != sb)
+                           return variant == 1 ? sa < sb : sa > sb;
+                         return a < b;
+                       });
+    } else if (variant >= 3) {
+      // rng_state is seeded per variant by run(): the shuffle stream
+      // is deterministic and distinct per restart
+      for (size_t i = rows_idx.size(); i > 1; --i)
+        std::swap(rows_idx[i - 1], rows_idx[rnd() % i]);
+    }
     std::vector<int> neworder;
     neworder.reserve(n);
     std::vector<uint8_t> seen(n, 0);
-    for (const Row &row : m.rows) {
-      if (row.lo <= 0) continue;
-      for (const Term &t : row.terms)
+    for (int r : rows_idx)
+      for (const Term &t : m.rows[r].terms)
         if (!seen[t.var]) {
           seen[t.var] = 1;
           neworder.push_back(t.var);
         }
-    }
     for (int v = 0; v < n; ++v)
       if (!seen[v]) neworder.push_back(v);
     order = std::move(neworder);
+    feas_rows = std::move(rows_idx);
     recompute_suffix();
+  }
+
+  // Dynamic least-constraining dive: the fixed-order dives above pack
+  // early demand rows greedily and chronological backtracking cannot
+  // climb out of a bad early packing — tiny (300-var) exact-band
+  // instances timed out down EVERY fixed order (fuzz round 4). This
+  // dive instead walks the demand rows and, inside the first
+  // unsatisfied one, sets the variable whose tightest remaining
+  // capacity row has the MOST slack (least-constraining value,
+  // randomized tie-break per variant). Once every demand row is met,
+  // remaining variables zero-fill under propagation.
+  int pick_feas_var() {
+    for (int r : feas_rows) {
+      if (act_lo[r] >= m.rows[r].lo) continue;
+      int best = -1;
+      uint64_t best_key = 0;
+      for (const Term &t : m.rows[r].terms) {
+        if (t.coef <= 0 || val[t.var] != -1) continue;
+        int64_t slack = kInf;
+        for (auto [r2, c2] : var_rows[t.var]) {
+          if (c2 <= 0 || m.rows[r2].hi >= kInf) continue;
+          slack = std::min(slack, m.rows[r2].hi - act_lo[r2]);
+        }
+        if (slack > (int64_t)1e6) slack = (int64_t)1e6;
+        if (slack < 0) slack = 0;
+        uint64_t key = ((uint64_t)slack << 4) | (rnd() & 15);
+        if (best == -1 || key > best_key) {
+          best = t.var;
+          best_key = key;
+        }
+      }
+      if (best != -1) return best;
+    }
+    return -1;  // every demand row satisfied
+  }
+
+  void dive() {
+    if (out_of_time() || have_best) return;
+    if (node_cap && nodes >= node_cap) {
+      phase_aborted = true;
+      return;
+    }
+    ++nodes;
+    int var = pick_feas_var();
+    if (var == -1) {
+      // demands met: zero-fill the rest (propagation may force 1s
+      // for remaining lower bands; any violation unwinds the fill)
+      Trail tr;
+      bool ok = true;
+      for (int v = 0; v < n && ok; ++v) {
+        if (val[v] != -1) continue;
+        std::vector<int> dirty;
+        ok = assign(v, 0, tr, dirty) && propagate(tr, dirty);
+      }
+      if (ok)
+        record_if_better();
+      else
+        bump_fail_row();
+      undo(tr);
+      return;
+    }
+    for (int8_t v : {(int8_t)1, (int8_t)0}) {
+      Trail tr;
+      std::vector<int> dirty;
+      if (assign(var, v, tr, dirty) && propagate(tr, dirty))
+        dive();
+      else
+        bump_fail_row();
+      undo(tr);
+      if (timed_out || phase_aborted || have_best) return;
+    }
   }
 
   void record_if_better() {
@@ -588,15 +721,37 @@ struct Solver {
     std::vector<int> all(m.rows.size());
     for (size_t r = 0; r < m.rows.size(); ++r) all[r] = (int)r;
     if (!propagate(root, all)) return 2;  // infeasible at the root
-    // phase 1: demand-row-major feasibility dive to seed an incumbent
-    // (node-capped; root-propagation fixes persist, its own trail
-    // unwinds fully). Phase 2 re-proves/improves it exactly, so a
-    // skipped or failed phase 1 costs nothing but the node budget.
+    // phase 1: feasibility dives to seed an incumbent (node-capped;
+    // root-propagation fixes persist, each dive's trail unwinds
+    // fully). A ladder of row orders runs until one lands a feasible
+    // leaf — a single fixed order leaves rc=7 holes on exact-band
+    // perfect-packing instances (see use_feasibility_order). Phase 2
+    // re-proves/improves the incumbent exactly, so a failed dive
+    // costs nothing but its node budget.
     const std::vector<int> obj_order = order;
-    use_feasibility_order();
     first_feasible_only = true;
-    node_cap = nodes + 2000000;
-    dfs(0);
+    for (int variant = 0; variant < 24 && !have_best && !out_of_time();
+         ++variant) {
+      phase_aborted = false;
+      rng_state = 0x9E3779B97F4A7C15ull * (uint64_t)(variant + 1);
+      if (variant < 2) {
+        // fixed-order dives: instant on the common case
+        use_feasibility_order(variant);
+        node_cap = nodes + (variant == 0 ? 1000000 : 200000);
+        dfs(0);
+      } else {
+        // dynamic least-constraining dives over varied row orders —
+        // tightest-band-first (exact rack totals lead), widest,
+        // shuffles, alternating with conflict-weighted restarts
+        // (rows that killed earlier dives lead); small caps with many
+        // restarts beat one deep dive on perfect-packing instances
+        use_feasibility_order(
+            variant >= 4 && variant % 2 == 0 ? -1 : variant - 1
+        );
+        node_cap = nodes + 200000;
+        dive();
+      }
+    }
     first_feasible_only = false;
     phase_aborted = false;
     node_cap = 0;
