@@ -306,7 +306,8 @@ def _compact_kernel(k: dict) -> dict:
 def _print_final(line: dict) -> None:
     """Emit the ONE stdout line, shedding optional detail if it would
     overflow the driver's tail capture. Never raises."""
-    for drop in ((), ("kernel",), ("scenarios", "rows_schema")):
+    for drop in ((), ("jumbo_cold_runs",), ("kernel",),
+                 ("scenarios", "rows_schema")):
         for key in drop:
             line.pop(key, None)
         s = json.dumps(line)
@@ -319,7 +320,8 @@ def _print_final(line: dict) -> None:
 def emit(head: dict | None, platform: str, tpu_error: str | None,
          scenario: str, run_error: str | None = None,
          scenarios: list[list] | None = None,
-         cold_cached: float | None = None) -> None:
+         cold_cached: float | None = None,
+         jumbo_runs: list[float] | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
         line = {
@@ -378,6 +380,10 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # r3 item 1: must fit the tail capture whole)
         line["rows_schema"] = ROW_SCHEMA
         line["scenarios"] = scenarios
+    if jumbo_runs:
+        # repeated fresh-process jumbo solves: the variance-discipline
+        # evidence (VERDICT r3 item 3 — bounded time AND spread)
+        line["jumbo_cold_runs"] = jumbo_runs
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)
@@ -469,8 +475,23 @@ def main() -> int:
                 if rc is not None:
                     cold_cached = rc["cold_wall_clock_s"]
 
+    jumbo_runs: list[float] | None = None
+    if args.all:
+        # variance discipline on the certification-heavy jumbo config:
+        # 4 more FRESH processes (cold each) so the artifact carries 5
+        # repeated runs, not a single lucky draw (VERDICT r3 item 3)
+        jrow = next((r for r in rows if r and r[0] == "jumbo"), None)
+        if jrow is not None and jrow[2] is not None:
+            jumbo_runs = [jrow[2]]
+            for _ in range(4):
+                rj, _ej = _run_child(args, "jumbo", env, warmrun=False)
+                if rj is None:
+                    break
+                jumbo_runs.append(rj["cold_wall_clock_s"])
+
     emit(head, platform, tpu_err, args.scenario, head_err,
-         scenarios=rows if args.all else None, cold_cached=cold_cached)
+         scenarios=rows if args.all else None, cold_cached=cold_cached,
+         jumbo_runs=jumbo_runs)
     return 0
 
 
