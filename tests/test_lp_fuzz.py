@@ -35,6 +35,8 @@ import os
 # never approaches the crash load.
 os.environ.setdefault("KAO_JIT_CACHE", "off")
 
+import sys
+
 import numpy as np
 import pytest
 
@@ -111,14 +113,17 @@ def test_lp_dialect_differential_fuzz(rng):
             continue
         try:
             lp = solve_lp_solve(inst, time_limit_s=15.0)
-        except RuntimeError:
-            # no incumbent within the limit: a search-depth pathology
-            # of the bundled DFS on extreme exact-band instances (the
-            # generator produces perfect-packing feasibility problems
-            # HiGHS needs LP relaxations for), NOT a dialect defect —
-            # the emitted LP was verified satisfiable by the MILP
-            # optimum when this class was first hit. Skipped, but
-            # floored below so wholesale breakage still fails.
+        except RuntimeError as e:
+            # ONLY the rc=7 no-incumbent case may be skip-counted: a
+            # search-depth pathology of the bundled DFS on extreme
+            # exact-band instances, NOT a dialect defect (the emitted
+            # LP was verified satisfiable by the MILP optimum when
+            # this class was first hit) — and measured ZERO since the
+            # round-4 phase-1 restart ladder. Every other RuntimeError
+            # (CLI crash, overrun, malformed decode) is a real defect
+            # and must fail the fuzz, not hide in the tally.
+            if "found no solution within" not in str(e):
+                raise
             hard += 1
             continue
         compared += 1
@@ -130,6 +135,11 @@ def test_lp_dialect_differential_fuzz(rng):
             )
         else:  # timeout incumbent may only undershoot
             assert lp.objective <= ex.objective, trial
+    # visible under -s: the soak evidence note in docs/OPTIMALITY.md
+    # quotes this tally (hard == rc=7 skips; zero since the round-4
+    # phase-1 restart ladder)
+    print(f"[lp-fuzz] compared={compared} hard_rc7={hard}",
+          file=sys.stderr)
     assert compared >= max(1, (compared + hard) // 2), (compared, hard)
 
 
