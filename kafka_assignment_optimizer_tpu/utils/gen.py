@@ -315,36 +315,75 @@ def _scatter_assignment(
         hi_t = max(-(-n_p // B), lo_t)
     else:
         lo_t = hi_t = n_p
-    for bound_pass in ("shed", "fill"):
-        for _ in range(n_p):
-            changed = False
-            for p, rr in enumerate(reps):
-                ld = leads[p]
-                if bound_pass == "shed":
-                    if lcnt[ld] <= hi_t:
-                        continue
-                    cand = [b for b in rr if lcnt[b] < hi_t]
-                else:
-                    if lcnt[ld] <= lo_t:
-                        continue
-                    cand = [b for b in rr if lcnt[b] < lo_t]
-                if not cand:
-                    continue
-                nb = min(cand, key=lambda b: (lcnt[b], b))
-                lcnt[ld] -= 1
-                lcnt[nb] += 1
-                leads[p] = nb
+    def promote(p, nb):
+        lcnt[leads[p]] -= 1
+        lcnt[nb] += 1
+        leads[p] = nb
+
+    for _ in range(4 * n_p):
+        if all(lo_t <= lcnt[b] <= hi_t for b in broker_ids):
+            break
+        changed = False
+        for p, rr in enumerate(reps):
+            ld = leads[p]
+            if lcnt[ld] > hi_t:
+                cand = [b for b in rr if lcnt[b] < hi_t]
+            elif lcnt[ld] > lo_t:
+                cand = [b for b in rr if lcnt[b] < lo_t]
+            else:
+                continue
+            if cand:
+                promote(p, min(cand, key=lambda b: (lcnt[b], b)))
                 changed = True
-            over = any(v > hi_t for v in lcnt.values())
-            under = any(v < lo_t for v in lcnt.values())
-            if bound_pass == "shed" and not over:
-                break
-            if bound_pass == "fill" and not under:
-                break
-            if not changed:
-                raise RuntimeError(
-                    "leader rebalance stalled; change the seed"
-                )
+        if all(lo_t <= lcnt[b] <= hi_t for b in broker_ids):
+            break
+        if changed:
+            continue
+        # single promotions are stuck: augment through an at-bound
+        # intermediary W (U gains via A, W compensates via B — the
+        # 2-hop chains some seeds need when every deficit broker only
+        # appears in partitions whose leaders sit exactly on a bound)
+        contains: dict[int, list[int]] = {b: [] for b in broker_ids}
+        for p, rr in enumerate(reps):
+            for b in rr:
+                contains[b].append(p)
+        for U in [b for b in broker_ids if lcnt[b] < lo_t]:
+            done = False
+            for A in contains[U]:
+                W = leads[A]
+                for Bp in contains[W]:
+                    V = leads[Bp]
+                    if V != W and lcnt[V] > lo_t:
+                        promote(Bp, W)  # W compensates first
+                        promote(A, U)
+                        done = changed = True
+                        break
+                if done:
+                    break
+        for V in [b for b in broker_ids if lcnt[b] > hi_t]:
+            done = False
+            for Bp in [p for p in range(n_p) if leads[p] == V]:
+                for W in reps[Bp]:
+                    if W == V:
+                        continue
+                    for A in [p for p in contains[W] if leads[p] == W]:
+                        X = [b for b in reps[A]
+                             if b != W and lcnt[b] < hi_t]
+                        if X:
+                            promote(A, min(X))  # W sheds first
+                            promote(Bp, W)
+                            done = changed = True
+                            break
+                    if done:
+                        break
+                if done:
+                    break
+        if not changed:
+            raise RuntimeError(
+                "leader rebalance stalled; change the seed"
+            )
+    if not all(lo_t <= lcnt[b] <= hi_t for b in broker_ids):
+        raise RuntimeError("leader rebalance did not converge")
     parts = []
     i = 0
     for topic, n, _rf in topic_rf:
