@@ -1041,6 +1041,20 @@ class ProblemInstance:
         n_cm = self._member_classes()[3].size
         return n_cm * 8 <= members
 
+    def agg_construct_viable(self) -> bool:
+        """True when the AGGREGATED kept-weight formulation would
+        accept this instance rather than refuse: small enough to grind
+        regardless (<= 20k members), or class collapse of at least 4x.
+        ``_kept_weight_agg``'s refusal and the engine's constructor-race
+        gate share this predicate so the two can never drift — past the
+        unaggregated-LP size a refusal here means the constructor has
+        NO viable path and racing it only delays the annealer."""
+        members = self._members()[0].size
+        if members <= 20_000:
+            return True
+        # n_cm <= members // 4 for integers — the refusal's complement
+        return self._member_classes()[3].size * 4 <= members
+
     def _kept_weight_agg(self, integer: bool = False,
                          return_solution: bool = False):
         """The level-2 kept-weight bound on the SYMMETRY-AGGREGATED
@@ -1085,8 +1099,7 @@ class ProblemInstance:
         # MILP burning its whole time limit to restate the level-2
         # verdict — refuse instead of grinding (certify_optimal and the
         # serve audit run these tiers synchronously)
-        members = self._members()[0].size
-        if members > 20_000 and n_cm > members // 4:
+        if not self.agg_construct_viable():
             return None
         opts = self._lp_options()
         if opts is None:  # bounds deadline already spent

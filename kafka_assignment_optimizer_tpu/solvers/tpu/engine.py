@@ -281,8 +281,25 @@ def _caps_bind(inst: ProblemInstance) -> bool:
 
 def _construct_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     """Bounds-thread body: decode the kept-replica LP into a plan and
-    certify it. Joins the main bounds prefetch first so the two workers
-    never duplicate the memoized bound computations."""
+    certify it. Except for the cheap viability pre-check below (which
+    may compute the class grouping concurrently with the bounds
+    worker — a benign duplicated memo fill, off the main thread), it
+    joins the main bounds prefetch first so the two workers never
+    duplicate the multi-second bound LPs."""
+    # past the unaggregated-LP size the constructor's only viable path
+    # is the aggregated formulation; when THAT will refuse
+    # (agg_construct_viable False — e.g. a shuffled 50k-partition
+    # cluster with ~1x class collapse) there is no route to a
+    # constructed plan in useful time: return at once so the engine's
+    # big-instance wait ends immediately instead of stalling 45 s
+    # while a ~900 s LP grinds this thread. Checked BEFORE the bounds
+    # join, and off the main thread, so solve startup never pays the
+    # class grouping.
+    if (
+        inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
+        and not inst.agg_construct_viable()
+    ):
+        return None, False
     try:
         bounds_fut.result()
     except Exception:

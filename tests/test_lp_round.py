@@ -154,3 +154,47 @@ def test_tiny_default_solve_races_exact_milp():
     # explicit knobs opt OUT of the race: the search engine runs
     r2 = optimize(solver="tpu", seed=0, engine="sweep", **sc.kwargs)
     assert not r2.solve.stats["constructed"]
+
+
+def test_big_asymmetric_skips_futile_constructor_race(monkeypatch):
+    """Past the unaggregated-LP size, an instance the aggregated
+    formulation would refuse (``agg_construct_viable`` False) has NO
+    viable constructor path — the race must not launch (it would delay
+    the annealer by the big-instance wait while a ~900 s LP grinds)."""
+    from kafka_assignment_optimizer_tpu.models import (
+        instance as inst_mod,
+    )
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        ProblemInstance,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
+
+    # the predicate itself, at FULL scale (cheap — no solve): ~1.02x
+    # class collapse over 29,883 members is far below the 4x floor
+    sc_full = gen.SCENARIOS["adversarial"]()
+    inst_full = build_instance(sc_full.current, sc_full.broker_list,
+                               sc_full.topology)
+    assert not inst_full.agg_construct_viable()
+    assert inst_full.agg_effective() is False
+
+    # the worker wiring, at smoke scale: a big + non-viable instance
+    # must return from the constructor worker at once — before the
+    # bounds join and before any LP work
+    import kafka_assignment_optimizer_tpu.solvers.lp_round as lp_round
+
+    sc = gen.SCENARIOS["adversarial"](**gen.SMOKE_KWARGS["adversarial"])
+    inst = build_instance(sc.current, sc.broker_list, sc.topology,
+                          target_rf=sc.target_rf)
+    monkeypatch.setattr(inst_mod, "AGG_MEMBER_THRESHOLD", 100)
+    monkeypatch.setattr(
+        ProblemInstance, "agg_construct_viable", lambda self: False
+    )
+    calls = []
+    monkeypatch.setattr(
+        lp_round, "construct",
+        lambda i: calls.append(1) or None,
+    )
+    r = eng.solve_tpu(inst, seed=0, engine="sweep")
+    assert r.stats["feasible"]
+    assert not r.stats["constructed"]
+    assert not calls, "futile construction was attempted"
