@@ -1293,19 +1293,57 @@ class ProblemInstance:
         With replica sets fixed, total weight = const + sum_p
         (w_lead - w_foll)[p, leader_p], one leader per partition, each
         broker leading within [leader_lo, leader_hi] — a transportation
-        LP (integral polytope), solved exactly with HiGHS via scipy.
-        Closes the gap one-swap-at-a-time local search cannot: chains of
-        leader reseats through near-cap brokers (the reference's
-        "preferred leader has more weight" objective,
-        ``/root/reference/README.md:131-133``, optimized exactly). The
-        other constraint families only see replica sets, so feasibility
-        is untouched. Returns ``a`` unchanged on any failure."""
+        problem (integral polytope). Closes the gap one-swap-at-a-time
+        local search cannot: chains of leader reseats through near-cap
+        brokers (the reference's "preferred leader has more weight"
+        objective, ``/root/reference/README.md:131-133``, optimized
+        exactly). The other constraint families only see replica sets,
+        so feasibility is untouched. Returns ``a`` unchanged on any
+        failure.
+
+        Solved by incremental negative-cycle canceling on the broker
+        lead-move graph (``_reseat_cycle_cancel``) — the engine hands
+        this an annealed candidate whose leadership is already
+        near-optimal, so a handful of O(B^3) Bellman-Ford passes beat
+        re-solving the 150k-variable transportation LP from scratch by
+        ~2 orders of magnitude (58 s -> <1 s at the 50k-partition
+        adv50k scale, measured r4). The HiGHS LP remains as the exact
+        fallback for inputs the canceller declines (leadership counts
+        already outside the band, which it cannot repair)."""
         a = np.asarray(a)
+        P, R = a.shape
+        if P == 0 or R == 0:
+            return a
+        try:
+            out = self._reseat_cycle_cancel(a)
+            if out is None:
+                out = self._best_leader_lp(a)
+            if out is None:
+                return a
+            # exactness guard against round-off / edge cases in either
+            # path: keep the better plan under (fewest violations, then
+            # weight). A feasible input can only improve; an
+            # infeasible-leadership input is legitimately repaired at a
+            # weight cost.
+            def rank(z):
+                return (
+                    -sum(self.violations(z).values()),
+                    self.preservation_weight(z),
+                )
+
+            return out if rank(out) >= rank(a) else a
+        except Exception:
+            # the documented contract: a malformed input degrades to
+            # "no reseat", never to a crashed solve
+            return a
+
+    def _best_leader_lp(self, a: np.ndarray) -> np.ndarray | None:
+        """Transportation-LP formulation of the exact leader reseat
+        (see ``best_leader_assignment``), solved with HiGHS via scipy.
+        Returns the reseated plan or None on solver failure."""
         P, R = a.shape
         B = self.num_brokers
         valid = self.slot_valid
-        if P == 0 or R == 0:
-            return a
         try:
             import scipy.sparse as sp
             from scipy.optimize import linprog
@@ -1344,10 +1382,13 @@ class ProblemInstance:
                     ]
                 ),
                 bounds=(0, 1),
-                method="highs",
+                # measured at 150k slots (r4): HiGHS simplex 58 s, IPM
+                # (with its default crossover to a basic solution,
+                # which the argmax decode below needs) 3.3 s
+                method="highs-ipm",
             )
             if not res.success:
-                return a
+                return None
             x = np.zeros((P, R))
             x[rows, cols] = res.x
             chosen = np.argmax(x, axis=1)  # integral LP: one ~1.0 per row
@@ -1356,20 +1397,176 @@ class ProblemInstance:
             lead = out[rng, chosen]
             out[rng, chosen] = out[:, 0]
             out[:, 0] = np.where(keep, lead, out[:, 0])
-            # exactness guard against LP round-off / fractional-vertex
-            # edge cases: keep the better plan under (fewest violations,
-            # then weight). A feasible input can only improve (the LP
-            # optimum dominates it); an infeasible-leadership input is
-            # legitimately repaired at a weight cost.
-            def rank(z):
-                return (
-                    -sum(self.violations(z).values()),
-                    self.preservation_weight(z),
-                )
-
-            return out if rank(out) >= rank(a) else a
+            return out
         except Exception:
-            return a
+            return None
+
+    def _reseat_cycle_cancel(self, a: np.ndarray) -> np.ndarray | None:
+        """Exact leader reseat by negative-cycle canceling (the fast
+        path of ``best_leader_assignment``).
+
+        View a leader arrangement as a flow on the broker lead-move
+        graph: reseating partition p from its current leader (broker
+        ``b = a[p, 0]``) to the member in slot s (broker
+        ``c = a[p, s]``) is an arc b -> c with integer cost
+        ``gain(p, 0) - gain(p, s)`` where ``gain = w_lead - w_foll`` of
+        the occupying broker; it shifts one lead from b to c. Any two
+        band-feasible arrangements of the same replica sets differ by a
+        set of broker-space cycles (lead counts unchanged) plus paths
+        (endpoints shift by one, still inside the band) — so an
+        arrangement with no negative cycle in the dense min-cost arc
+        matrix (paths modeled via a virtual node with zero-cost arcs to
+        brokers that can shed a lead and from brokers that can absorb
+        one) is globally optimal: the standard min-cost-flow optimality
+        argument on an integral transportation polytope.
+
+        Each Bellman-Ford pass is a vectorized [B+1, B+1] min-plus
+        sweep; every applied cycle raises the exact integer objective
+        by >= 1, so termination is bounded by the optimality gap of the
+        input — a handful of iterations for the near-optimal candidates
+        the engine feeds here, independent of partition count (the only
+        O(P) work per iteration is rebuilding the arc mins).
+
+        Returns the optimal reseat, or None to decline: leadership
+        counts already outside the band (this routine permutes leads,
+        it cannot repair counts — the LP fallback handles repair), or
+        the iteration cap tripped (never observed; a guard, not a
+        budget)."""
+        P, R = a.shape
+        B = self.num_brokers
+        valid = self.slot_valid
+        keep = self.rf > 0
+        if (keep & (a[:, 0] >= B)).any():
+            return None  # live partition with no in-range leader
+        lcnt = np.bincount(a[keep, 0], minlength=B)[:B]
+        if (lcnt < self.leader_lo).any() or (lcnt > self.leader_hi).any():
+            return None
+        prow = np.arange(P)[:, None]
+        # candidate arcs: (p, s>=1) valid follower slots of live
+        # partitions; arc out[p,0] -> out[p,s] at cost
+        # gain[p,0]-gain[p,s] (gain = lead-over-follow weight of the
+        # occupying broker; slot-keyed, so recomputed after each
+        # applied cycle's swaps)
+        arc_mask = valid.copy()
+        arc_mask[:, 0] = False
+        arc_mask &= keep[:, None] & (a < B)
+        p_arc, s_arc = np.nonzero(arc_mask)
+        if p_arc.size == 0:
+            # no alternative leaders anywhere: a is optimal as-is (the
+            # LP could not change anything either — its only choice is
+            # which valid slot leads)
+            return a.copy()
+        out = a.copy()
+        INF = np.int64(1) << 40
+        N = B + 1  # + virtual node for band-shifting paths
+        for _ in range(256):  # cap >> any observed cycle count
+            gain = np.where(
+                valid & (out < B),
+                self.w_leader[prow, out] - self.w_follower[prow, out],
+                0,
+            ).astype(np.int64)
+            b_from = out[p_arc, 0]
+            b_to = out[p_arc, s_arc]
+            cost = gain[p_arc, 0] - gain[p_arc, s_arc]
+            C = np.full((N, N), INF, dtype=np.int64)
+            np.minimum.at(C, (b_from, b_to), cost)
+            np.fill_diagonal(C, INF)  # self-arcs are no-ops
+            C[:B, B] = np.where(lcnt + 1 <= self.leader_hi, 0, INF)
+            C[B, :B] = np.where(lcnt - 1 >= self.leader_lo, 0, INF)
+            # all-source Bellman-Ford: dist starts at 0 everywhere, so
+            # any relaxation still possible after N sweeps lies on a
+            # negative cycle reachable through the parent chain. The
+            # engine's candidates are near-optimal, so their cancel
+            # cycles are SHORT — probe the parent chain of one improved
+            # node every sweep and stop at the first revisit, instead
+            # of paying all N min-plus sweeps per cycle (the difference
+            # between ~25 ms and ~0.6 s per canceled cycle at B=511)
+            dist = np.zeros(N, dtype=np.int64)
+            parent = np.full(N, -1, dtype=np.int64)
+
+            def cycle_edges(v):
+                """Simple parent cycle through v (which must lie ON the
+                cycle) as forward arcs, or None if the walk leaves the
+                parent graph / exceeds N steps (v was not on a cycle
+                after all) or the total cost is not negative —
+                mid-flux (Jacobi) parent graphs can transiently hold
+                non-improving cycles, which must not be applied."""
+                cyc = [v]
+                u = int(parent[v])
+                while u != v:
+                    if u < 0 or len(cyc) > N:
+                        return None
+                    cyc.append(u)
+                    u = int(parent[u])
+                cyc.reverse()  # parent chain is reversed arc order
+                edges = list(zip(cyc, cyc[1:] + cyc[:1]))
+                if sum(int(C[b, c]) for b, c in edges) >= 0:
+                    return None
+                return edges
+
+            edges = None
+            for _sweep in range(N):
+                cand = dist[:, None] + C
+                nb = cand.argmin(axis=0)
+                nd = cand[nb, np.arange(N)]
+                better = nd < dist
+                if not better.any():
+                    break
+                dist = np.where(better, nd, dist)
+                parent = np.where(better, nb, parent)
+                u = int(np.flatnonzero(better)[0])
+                seen = np.full(N, False)
+                for _step in range(N + 1):
+                    if u < 0:
+                        break
+                    if seen[u]:
+                        edges = cycle_edges(u)
+                        break
+                    seen[u] = True
+                    u = int(parent[u])
+                if edges is not None:
+                    break
+            else:
+                # N sweeps still improving: a negative cycle certainly
+                # exists; walk N parents from an improving node to land
+                # on one (guarding the walk — Jacobi parent chains can
+                # terminate at a never-improved root)
+                v = int(np.flatnonzero(better)[0])
+                for _step in range(N):
+                    nxt = int(parent[v])
+                    if nxt < 0:
+                        return None  # chain left the parent graph
+                    v = nxt
+                edges = cycle_edges(v)
+                if edges is None:
+                    return None  # non-negative parent cycle: LP decides
+            if edges is None:
+                break  # no negative cycle: optimal
+            # apply: for each arc b -> c on the cycle (skipping the
+            # virtual node), reseat one witness partition achieving the
+            # arc's min cost. Cycle nodes are distinct brokers, so the
+            # witnesses are distinct partitions (one current leader
+            # broker each).
+            applied = False
+            for b, c in edges:
+                if b == B or c == B:
+                    continue  # virtual-node legs carry no reseat
+                hit = np.flatnonzero(
+                    (b_from == b) & (b_to == c) & (cost == C[b, c])
+                )
+                if hit.size == 0:
+                    return None  # stale witness: decline, LP decides
+                k = int(hit[0])
+                p, s = int(p_arc[k]), int(s_arc[k])
+                out[p, 0], out[p, s] = out[p, s], out[p, 0]
+                lcnt[b] -= 1
+                lcnt[c] += 1
+                applied = True
+            if not applied:
+                break
+        else:
+            return None  # iteration cap: decline rather than loop
+        return out
 
     def move_count(self, a: np.ndarray) -> int:
         """Replica moves vs the current assignment: count of valid slots

@@ -387,6 +387,7 @@ def _solve_tpu_inner(
     early_stopped = False
     certified_a = None
     constructed = False
+    final_cert = None  # certify-first outcome at final selection
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
     rounds_run = 0
     lp_warm = None
@@ -758,17 +759,17 @@ def _solve_tpu_inner(
                         lb_exact, ub0 = bounds_fut.result()
                         if mc <= lb_exact:
                             w_cand = inst.preservation_weight(cand)
-                            if w_cand < ub0 and (
-                                inst.total_replicas <= 60_000
-                                and reseat_tries < 3
-                            ):
-                                # below the bound: a leader reseat
-                                # (transportation LP) can lift it. The
-                                # LP costs seconds at scale (~7.5 s at
-                                # 150k slots), so boundaries never run
-                                # it on huge instances and at most 3
-                                # times elsewhere — the final
-                                # certification reseats once regardless
+                            if w_cand < ub0 and reseat_tries < 3:
+                                # below the bound: a leader reseat can
+                                # lift it. The negative-cycle canceller
+                                # handles a near-optimal candidate in
+                                # well under a second even at 150k
+                                # slots (r4; the LP this replaced cost
+                                # ~7.5-58 s there and boundaries had to
+                                # skip huge instances), so every size
+                                # gets at most 3 boundary tries — the
+                                # final certification reseats once
+                                # regardless
                                 reseat_tries += 1
                                 cand = inst.best_leader_assignment(cand)
                                 w_cand = inst.preservation_weight(cand)
@@ -830,68 +831,122 @@ def _solve_tpu_inner(
         cand = pop_a[jnp.argmax(
             jnp.where(tied, -moves, jnp.iinfo(jnp.int32).min)
         )]
-        pol = polish_jit
-        if polish_fut is not None:
-            # join the ladder-overlapped compile (free when the ladder
-            # outlasted it, and never slower than starting a second
-            # compile of the same executable here); any AOT mismatch
-            # (sharding, aval) falls back to the jitted path below
-            try:
-                budget = _budget_left(t0, time_limit_s)
-                pol = polish_fut.result(
-                    timeout=60.0 if budget is None else max(budget, 0.0)
-                )
-            except Exception:
-                pol = polish_jit
-        try:
-            best_a = pol(m, cand)
-        except Exception:
-            best_a = polish_jit(m, cand)
-        best_a = np.asarray(best_a, dtype=np.int32)
+        # certify FIRST, polish only on failure: the polish cannot
+        # improve a proven global optimum, and its steepest descent
+        # applies ONE move per [P, R, B] evaluation — ~a minute of
+        # execution at 50k partitions — so paying for it when the raw
+        # champion (plus at most one exact leader reseat) already meets
+        # both bounds would put dead weight on every certified solve's
+        # critical path. The attempt mirrors the chunk-boundary
+        # certificate: cheap host checks, then the reseat LP only when
+        # leadership alone trails the weight bound. Joins block (no
+        # .done() polls), so multi-controller workers reach identical
+        # verdicts. On failure the flow falls through to exactly the
+        # polish -> reseat -> compare path below.
+        certified_final = None
+        final_cert = "budget_spent"  # why the attempt concluded
         budget = _budget_left(t0, time_limit_s)
-        try:
-            # join bounded by the remaining deadline budget: when the
-            # ladder outlasted the prefetch (the usual case) this is
-            # free, but a timed-out solve must not stall on a
-            # straggling LP
-            _, ub0 = bounds_fut.result(timeout=budget)
-        except Exception:
-            ub0 = None
-        if (
-            inst.is_feasible(best_a)
-            and (budget is None or budget > 0)  # deadline not exhausted
-            and (ub0 is None
-                 or inst.preservation_weight(best_a) < ub0)
-        ):
-            # below the weight bound: exact leader reseat (zero replica
-            # movement) — weight-improving or a no-op
-            best_a = inst.best_leader_assignment(best_a)
-        if lp_fut is not None:
-            # even an uncertified constructed plan may outrank the
-            # annealed one — compare under the solve's lexicographic
-            # objective (feasible, weight, fewest moves). Recompute the
-            # budget: the bounds join above may have consumed the last
-            # of it
+        if budget is None or budget > 0:
+            # cap the pre-polish join so an instance with a straggling
+            # bounds ladder AND a real optimality gap keeps the old
+            # overlap (polish runs while the LPs finish; the post-polish
+            # join below still waits). Under multi-controller SPMD the
+            # join must stay unbounded: a wall-clock cap could resolve
+            # differently per worker and diverge the control flow.
+            join_cap = budget if (multi or budget is not None) else 15.0
+            try:
+                lb_exact, ub0 = bounds_fut.result(timeout=join_cap)
+            except Exception:
+                lb_exact = ub0 = None
+            if ub0 is None:
+                final_cert = "bounds_unavailable"
+            else:
+                cand_np = np.asarray(cand, dtype=np.int32)
+                if inst.move_count(cand_np) > lb_exact:
+                    final_cert = "moves_above_lb"
+                elif not inst.is_feasible(cand_np):
+                    final_cert = "infeasible"
+                elif inst.preservation_weight(cand_np) >= ub0:
+                    certified_final = cand_np
+                    final_cert = "ok"
+                else:
+                    reseated = inst.best_leader_assignment(cand_np)
+                    if inst.preservation_weight(reseated) >= ub0:
+                        # replica sets unchanged by the reseat, so
+                        # the move bound still holds
+                        certified_final = reseated
+                        final_cert = "ok_reseat"
+                    else:
+                        final_cert = "weight_below_ub"
+        if certified_final is not None:
+            best_a = certified_final
+            t_polish = time.perf_counter()
+            # the final proof block below re-derives the certificate
+            # from the (memoized) bounds — no special-casing needed
+        else:
+            pol = polish_jit
+            if polish_fut is not None:
+                # join the ladder-overlapped compile (free when the
+                # ladder outlasted it, and never slower than starting a
+                # second compile of the same executable here); any AOT
+                # mismatch (sharding, aval) falls back to the jitted
+                # path below
+                try:
+                    budget = _budget_left(t0, time_limit_s)
+                    pol = polish_fut.result(
+                        timeout=60.0 if budget is None else max(budget, 0.0)
+                    )
+                except Exception:
+                    pol = polish_jit
+            try:
+                best_a = pol(m, cand)
+            except Exception:
+                best_a = polish_jit(m, cand)
+            best_a = np.asarray(best_a, dtype=np.int32)
             budget = _budget_left(t0, time_limit_s)
             try:
-                plan, _ok = lp_fut.result(
-                    timeout=10.0 if budget is None else budget
-                )
+                # join bounded by the remaining deadline budget: when
+                # the ladder outlasted the prefetch (the usual case)
+                # this is free, but a timed-out solve must not stall on
+                # a straggling LP
+                _, ub0 = bounds_fut.result(timeout=budget)
             except Exception:
-                plan = None
-            if plan is not None:
-                def rank(zz):
-                    return (
-                        inst.is_feasible(zz),
-                        inst.preservation_weight(zz),
-                        -inst.move_count(zz),
+                ub0 = None
+            if (
+                inst.is_feasible(best_a)
+                and (budget is None or budget > 0)  # deadline left
+                and (ub0 is None
+                     or inst.preservation_weight(best_a) < ub0)
+            ):
+                # below the weight bound: exact leader reseat (zero
+                # replica movement) — weight-improving or a no-op
+                best_a = inst.best_leader_assignment(best_a)
+            if lp_fut is not None:
+                # even an uncertified constructed plan may outrank the
+                # annealed one — compare under the solve's lexicographic
+                # objective (feasible, weight, fewest moves). Recompute
+                # the budget: the bounds join above may have consumed
+                # the last of it
+                budget = _budget_left(t0, time_limit_s)
+                try:
+                    plan, _ok = lp_fut.result(
+                        timeout=10.0 if budget is None else budget
                     )
+                except Exception:
+                    plan = None
+                if plan is not None:
+                    def rank(zz):
+                        return (
+                            inst.is_feasible(zz),
+                            inst.preservation_weight(zz),
+                            -inst.move_count(zz),
+                        )
 
-                plan = np.asarray(plan, dtype=np.int32)
-                if rank(plan) > rank(best_a):
-                    best_a = plan
-                    constructed = True
-        t_polish = time.perf_counter()
+                    plan = np.asarray(plan, dtype=np.int32)
+                    if rank(plan) > rank(best_a):
+                        best_a = plan
+                        constructed = True
+            t_polish = time.perf_counter()
 
     # host-side exact verification (SURVEY.md §4.3 property): the engine's
     # incremental scores must agree with the numpy oracle
@@ -978,6 +1033,11 @@ def _solve_tpu_inner(
             "scorer": scorer,
             **({"pallas_fallback": pallas_fallback} if pallas_fallback
                else {}),
+            # certify-first outcome at final selection (None when a
+            # boundary/constructor certificate made it moot): "ok" /
+            # "ok_reseat" mean the polish was provably unnecessary and
+            # was skipped; anything else names the failed check
+            **({"final_cert": final_cert} if final_cert else {}),
             # chain: Metropolis steps per chain; sweep: every sweep
             # proposes one move per partition
             "total_steps": rounds_run * steps_per_round
