@@ -489,6 +489,34 @@ def jumbo(
     )
 
 
+def adv50k(
+    n_brokers: int = 512, n_racks: int = 16,
+    n_topics_low: int = 126, n_topics_high: int = 124,
+    parts_per_topic: int = 200, seed: int = 7,
+) -> Scenario:
+    """Constructor-proof at JUMBO scale: the adversarial shuffled
+    mixed-RF decommission grown to 512 brokers / 16 racks / 50k
+    partitions (149,600 replica slots — jumbo's size with adversarial's
+    asymmetry). The 126/124 topic split keeps the broker bands
+    removal-invariant ([292, 292] both sides; leaders [97, 98] both),
+    so caps stay slack. ~147k symmetry classes over ~149k members, so
+    the aggregated MILP refuses, and the sweep annealer must close to
+    the bound ladder on-chip at 5x the headline scale — the proof that
+    the search engine's flat sequential depth survives where the host
+    constructors cannot follow."""
+    sc = adversarial(
+        n_brokers=n_brokers, n_racks=n_racks,
+        n_topics_low=n_topics_low, n_topics_high=n_topics_high,
+        parts_per_topic=parts_per_topic, seed=seed,
+    )
+    return replace(
+        sc, name="adv50k",
+        notes=(f"{n_brokers}b/"
+               f"{(n_topics_low + n_topics_high) * parts_per_topic}-part "
+               f"shuffled mixed-RF decommission; {sc.notes}"),
+    )
+
+
 SCENARIOS = {
     "demo": demo,
     "scale_out": scale_out,
@@ -496,6 +524,7 @@ SCENARIOS = {
     "rf_change": rf_change,
     "leader_only": leader_only,
     "adversarial": adversarial,
+    "adv50k": adv50k,
     "jumbo": jumbo,
 }
 
@@ -510,5 +539,7 @@ SMOKE_KWARGS = {
     "leader_only": dict(n_brokers=32, n_topics=8, parts_per_topic=25),
     "adversarial": dict(n_brokers=32, n_topics_low=11, n_topics_high=9,
                         parts_per_topic=10),
+    "adv50k": dict(n_brokers=48, n_topics_low=6, n_topics_high=6,
+                   parts_per_topic=10),
     "jumbo": dict(n_brokers=48, n_topics=10, parts_per_topic=40),
 }

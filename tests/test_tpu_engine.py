@@ -326,6 +326,77 @@ def test_adversarial_full_scale_gates():
     assert sc.min_moves_lb == inst.move_lower_bound()
 
 
+def test_adv50k_full_scale_gates():
+    """The FULL-SIZE adv50k instance (512 brokers / 50k partitions,
+    149,600 replica slots) keeps the constructor-proof gate profile at
+    5x the headline scale — instance-level facts only, no solve."""
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    sc = gen.SCENARIOS["adv50k"]()
+    inst = build_instance(sc.current, sc.broker_list, sc.topology,
+                          target_rf=sc.target_rf)
+    assert inst.num_parts == 50_000
+    assert inst.num_brokers == 511
+    assert inst.total_replicas == 149_600
+    assert not inst.caps_bind()
+    assert not inst.agg_effective()
+    # big + barely-collapsing: the aggregated constructor must refuse
+    # outright rather than race a futile MILP
+    assert not inst.agg_construct_viable()
+    assert sc.min_moves_lb == inst.move_lower_bound()
+
+
+def test_adv50k_smoke_solves_proven():
+    """The shrunk adv50k config (bench --smoke) keeps the generator
+    invariants and is solved feasible + proven by the sweep engine —
+    the same contract the full-size bench row rests on."""
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    sc = gen.SCENARIOS["adv50k"](**gen.SMOKE_KWARGS["adv50k"])
+    inst = build_instance(sc.current, sc.broker_list, sc.topology,
+                          target_rf=sc.target_rf)
+    assert not inst.caps_bind()
+    r = optimize(solver="tpu", seed=0, engine="sweep", **sc.kwargs)
+    s = r.solve.stats
+    assert s["engine"] == "sweep"
+    assert s["feasible"]
+    assert s["proved_optimal"]
+    assert s["moves"] == sc.min_moves_lb
+
+
+def test_certified_solve_skips_polish(monkeypatch):
+    """Certify-first final selection: a sweep solve whose champion
+    (plus at most one exact leader reseat) meets both bounds must never
+    EXECUTE the steepest-descent polish — at 50k partitions that
+    execution is ~a minute of dead weight on a proven optimum (the
+    measured r4 cost of polishing the already-optimal adv50k champion).
+    The AOT compile thread may still run; only __call__ is the waste."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu import polish as pol_mod
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    calls = []
+    real = pol_mod.polish_jit
+
+    class Spy:
+        def __call__(self, *a, **k):
+            calls.append("run")
+            return real(*a, **k)
+
+        def lower(self, *a, **k):
+            # poison the AOT path: the engine's overlapped compile then
+            # fails and any polish EXECUTION must fall back to
+            # __call__ above — so a regressed certify-first (polish
+            # running on a certified solve) cannot slip through the
+            # compiled executable unseen
+            raise RuntimeError("AOT polish disabled by test")
+
+    monkeypatch.setattr(pol_mod, "polish_jit", Spy())
+    sc = gen.SCENARIOS["adversarial"](**gen.SMOKE_KWARGS["adversarial"])
+    r = optimize(solver="tpu", seed=0, engine="sweep", **sc.kwargs)
+    assert r.solve.stats["proved_optimal"]
+    assert calls == []
+
+
 @pytest.mark.parametrize("seed", [7, 11, 23, 101])
 def test_adversarial_generator_invariants(seed):
     """The adversarial generator's gate profile must hold for ANY seed,
