@@ -204,15 +204,42 @@ def _realize(inst, xi, yi, quota, mrows, mcols) -> np.ndarray | None:
                 inst, a, vac, leaderless, lead_quota
             )
         if assign is None:
-            assign = _complete_maxflow(inst, a, vac, quota)
+            flow = _complete_maxflow(inst, a, vac, quota)
+            assign = (
+                None if flow is None else [(p, b, False) for p, b in flow]
+            )
         if assign is None:
             return None
-        for p, b in assign:
+        for p, b, _lead in assign:
             row = a[p]
             vac_slots = np.flatnonzero((row == B) & valid[p])
             a[p, vac_slots[0]] = b
+    else:
+        assign = []
     if ((a == B) & valid).any():
         return None
+
+    # pre-seat slot 0 before the exact reseat: the kept leaders (y —
+    # the LP/MILP's own leader choice, in-band by its leader rows) plus
+    # the completion's lead-channel placements. Slot order was
+    # arbitrary up to here, so without this the reseat sees random
+    # leader counts, its fast cycle-canceller declines (out-of-band
+    # input), and every constructed solve pays the full transportation
+    # LP instead — measured 3.9 s of the jumbo's 16 s wall (r4).
+    lead_b_of = np.full(P, -1, dtype=np.int64)
+    for p, b, lead in assign:
+        if lead:
+            lead_b_of[p] = b
+    lead_b_of[mrows[yi]] = mcols[yi]  # kept leaders win over coverage
+    prows = np.flatnonzero(lead_b_of >= 0)
+    if prows.size:
+        hit = a[prows] == lead_b_of[prows, None]
+        s0 = hit.argmax(axis=1)
+        ok = hit[np.arange(prows.size), s0]
+        prows, s0 = prows[ok], s0[ok]
+        lead_vals = a[prows, s0].copy()
+        a[prows, s0] = a[prows, 0]
+        a[prows, 0] = lead_vals
 
     a = a.astype(np.int32)
     a = inst.best_leader_assignment(a)
@@ -311,8 +338,11 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
       filled with absolute priority (a completion that leaves a floor
       unmet is infeasible anyway).
 
-    Returns [(p, broker)] or None; the caller verifies the final plan,
-    so any shortfall here only costs the attempt."""
+    Returns [(p, broker, through_lead_channel)] or None; the caller
+    verifies the final plan, so any shortfall here only costs the
+    attempt. The lead flag marks placements the flow routed through a
+    broker's lead quota — the caller's slot-0 pre-seat uses them so the
+    exact reseat starts from in-band leader counts."""
     try:
         from ..native import mcmf
     except Exception:
@@ -452,16 +482,18 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
     out = []
     n0 = pv.size + U
     n_plain = int((~lead_e).sum())
+    p_pl, b_pl = eb_p[~lead_e], eb_b[~lead_e]
+    p_ld, b_ld = eb_p[lead_e], eb_b[lead_e]
     pf = arc_flow[n0:n0 + n_plain]
     for i in np.flatnonzero(pf):
-        out.extend([(int(eb_p[~lead_e][i]), int(eb_b[~lead_e][i]))]
-                   * int(pf[i]))
-    # a lead candidate is placed iff its (p, k) -> mid arc carries flow
-    # (whichever outgoing channel it took)
+        out.extend([(int(p_pl[i]), int(b_pl[i]), False)] * int(pf[i]))
+    # a lead candidate is placed iff its (p, k) -> mid arc carries flow;
+    # it consumed lead quota iff the mid -> gate channel carried it
+    # (the bypass is a plain placement)
     lf = arc_flow[n0 + n_plain:n0 + n_plain + n_lead]
+    gf = arc_flow[n0 + n_plain + n_lead:n0 + n_plain + 2 * n_lead]
     for i in np.flatnonzero(lf):
-        out.extend([(int(eb_p[lead_e][i]), int(eb_b[lead_e][i]))]
-                   * int(lf[i]))
+        out.extend([(int(p_ld[i]), int(b_ld[i]), bool(gf[i]))] * int(lf[i]))
     return out
 
 

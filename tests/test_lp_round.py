@@ -99,7 +99,10 @@ def test_mcmf_completion_survives_binding_lead_gates():
         lead_quota=np.array([1, 0]),
     )
     assert out is not None
-    assert sorted(out) == [(0, 0), (1, 0)]
+    assert sorted((p, b) for p, b, _lead in out) == [(0, 0), (1, 0)]
+    # exactly one went through the rewarded lead channel; the other
+    # took the cost-0 bypass (lead_quota[0] is 1)
+    assert sum(lead for _p, _b, lead in out) == 1
 
 
 def test_engine_uses_constructed_plan():
