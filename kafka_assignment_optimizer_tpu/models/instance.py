@@ -626,6 +626,59 @@ class ProblemInstance:
         except Exception:
             return None
 
+    def _leader_cap_flow(self, gain, rows, cols, ids, base) -> int | None:
+        """Exact cap-only leader bound on the native min-cost-flow
+        kernel (the fast path of ``_leader_cap_lp``): the transportation
+        polytope is integral, so integer flows reach the identical LP
+        optimum. Returns None (caller falls back to the LP) when the
+        native kernel is unavailable, the gains are non-integral, or
+        the bounds deadline is already spent."""
+        try:
+            from ..native import mcmf
+        except Exception:
+            return None
+        if self._lp_options() is None:  # bounds deadline already spent
+            return None
+        g = gain[rows, cols]
+        g_int = np.asarray(g, np.int64)
+        if not np.array_equal(g_int, g):
+            return None
+        b_of = ids[rows, cols].astype(np.int64)
+        up, pidx = np.unique(rows, return_inverse=True)
+        ub, bidx = np.unique(b_of, return_inverse=True)
+        nP, nB, n = up.size, ub.size, rows.size
+        o_b = 1 + nP
+        t = o_b + nB
+        src = np.concatenate([
+            np.zeros(nP, np.int64),      # s -> p
+            1 + pidx,                    # p -> broker (gain arcs)
+            1 + np.arange(nP),           # p -> t (zero-cost disposal)
+            o_b + np.arange(nB),         # broker -> t
+        ])
+        dst = np.concatenate([
+            1 + np.arange(nP),
+            o_b + bidx,
+            np.full(nP, t, np.int64),
+            np.full(nB, t, np.int64),
+        ])
+        cap = np.concatenate([
+            np.ones(nP, np.int64),
+            np.ones(n, np.int64),
+            np.ones(nP, np.int64),
+            np.full(nB, int(self.leader_hi), np.int64),
+        ])
+        cost = np.concatenate([
+            np.zeros(nP, np.int64),
+            -g_int,
+            np.zeros(nP, np.int64),
+            np.zeros(nB, np.int64),
+        ])
+        try:
+            _f, c, _af = mcmf(src, dst, cap, cost, 0, t, t + 1)
+        except Exception:
+            return None
+        return base + int(-c)
+
     def _leader_cap_lp(self, with_lower: bool = False) -> int | None:
         """max_weight with the per-broker leadership constraints modeled
         exactly. Each partition either hands leadership to a member m
@@ -656,6 +709,20 @@ class ProblemInstance:
             return base
         if self.leader_hi <= 0:
             return base
+        if not with_lower:
+            # the cap-only model is a pure transportation problem:
+            # source -> partition (cap 1) -> gainful member's broker
+            # (cost -gain) -> sink (cap leader_hi), plus a zero-cost
+            # partition -> sink disposal arc so the forced max flow
+            # never routes a positive-cost path. Integer flows solve
+            # the SAME integral polytope the LP does, on the native
+            # min-cost-flow kernel — 5.3 s of HiGHS IPM -> ~0.3 s at
+            # the 50k-partition adv50k size (measured r4), and this
+            # bound sits on the certificate critical path of every
+            # annealed solve. The LP below stays as the fallback.
+            b = self._leader_cap_flow(gain, rows, cols, ids, base)
+            if b is not None:
+                return b
         try:
             import scipy.sparse as sp
             from scipy.optimize import linprog

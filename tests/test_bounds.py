@@ -205,6 +205,59 @@ def test_engine_early_stops_with_proof(monkeypatch):
     assert s["moves"] == s["moves_lb"]
 
 
+def test_leader_cap_flow_matches_lp_oracle(rng):
+    """The native-flow fast path of the cap-only leader bound equals
+    the scipy transportation LP on random clusters. The flow IS the
+    level-0 certificate bound (r4 rewrite: 5.3 s of HiGHS IPM ->
+    ~0.2 s at 50k partitions), so a silent divergence would produce
+    false certificates — pin it to the LP oracle it replaced."""
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        Assignment,
+        PartitionAssignment,
+        Topology,
+    )
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        ProblemInstance,
+        build_instance,
+    )
+
+    checked = 0
+    for trial in range(12):
+        n_b = int(rng.integers(4, 16))
+        n_racks = int(rng.integers(1, 4))
+        n_p = int(rng.integers(3, 40))
+        rf = int(rng.integers(1, min(4, n_b)))
+        topo = Topology.from_dict(
+            {str(b): f"r{b % n_racks}" for b in range(n_b)}
+        )
+        parts = [
+            PartitionAssignment(
+                topic="t", partition=p,
+                replicas=rng.choice(n_b, size=rf, replace=False).tolist(),
+            )
+            for p in range(n_p)
+        ]
+        drop = int(rng.integers(0, n_b)) if rng.random() < 0.5 else None
+        brokers = [b for b in range(n_b) if b != drop]
+        inst = build_instance(
+            Assignment(partitions=parts), brokers, topo
+        )
+        flow = inst._leader_cap_lp(with_lower=False)
+        # force the scipy path by disabling the flow fast path
+        orig = ProblemInstance._leader_cap_flow
+        ProblemInstance._leader_cap_flow = lambda self, *a, **k: None
+        try:
+            inst2 = build_instance(
+                Assignment(partitions=parts), brokers, topo
+            )
+            lp = inst2._leader_cap_lp(with_lower=False)
+        finally:
+            ProblemInstance._leader_cap_flow = orig
+        assert flow == lp, (trial, flow, lp)
+        checked += 1
+    assert checked == 12
+
+
 def test_proof_claims_sound_on_random_clusters(rng):
     """A claimed certificate must NEVER be wrong: on random adversarial
     clusters, every proved_optimal plan's objective equals the exact
