@@ -1374,9 +1374,12 @@ class ProblemInstance:
         near-optimal, so a handful of O(B^3) Bellman-Ford passes beat
         re-solving the 150k-variable transportation LP from scratch by
         ~2 orders of magnitude (58 s -> <1 s at the 50k-partition
-        adv50k scale, measured r4). The HiGHS LP remains as the exact
-        fallback for inputs the canceller declines (leadership counts
-        already outside the band, which it cannot repair)."""
+        adv50k scale, measured r4). Out-of-band leadership counts are
+        repaired first by cheapest lead-shift paths (same arc
+        machinery), so constructed plans and scrambled inputs stay on
+        the fast path too; the HiGHS LP remains as the exact fallback
+        for the rare inputs the canceller still declines (repair
+        budget or iteration cap tripped)."""
         a = np.asarray(a)
         P, R = a.shape
         if P == 0 or R == 0:
@@ -1494,11 +1497,9 @@ class ProblemInstance:
         the engine feeds here, independent of partition count (the only
         O(P) work per iteration is rebuilding the arc mins).
 
-        Returns the optimal reseat, or None to decline: leadership
-        counts already outside the band (this routine permutes leads,
-        it cannot repair counts — the LP fallback handles repair), or
-        the iteration cap tripped (never observed; a guard, not a
-        budget)."""
+        Returns the optimal reseat, or None to decline: the band-repair
+        budget or iteration cap tripped (guards, not budgets — neither
+        has been observed on engine-fed candidates)."""
         P, R = a.shape
         B = self.num_brokers
         valid = self.slot_valid
@@ -1506,8 +1507,6 @@ class ProblemInstance:
         if (keep & (a[:, 0] >= B)).any():
             return None  # live partition with no in-range leader
         lcnt = np.bincount(a[keep, 0], minlength=B)[:B]
-        if (lcnt < self.leader_lo).any() or (lcnt > self.leader_hi).any():
-            return None
         prow = np.arange(P)[:, None]
         # candidate arcs: (p, s>=1) valid follower slots of live
         # partitions; arc out[p,0] -> out[p,s] at cost
@@ -1518,23 +1517,142 @@ class ProblemInstance:
         arc_mask[:, 0] = False
         arc_mask &= keep[:, None] & (a < B)
         p_arc, s_arc = np.nonzero(arc_mask)
+        in_band = (
+            (lcnt >= self.leader_lo).all()
+            and (lcnt <= self.leader_hi).all()
+        )
         if p_arc.size == 0:
-            # no alternative leaders anywhere: a is optimal as-is (the
-            # LP could not change anything either — its only choice is
-            # which valid slot leads)
-            return a.copy()
+            # no alternative leaders anywhere: a is optimal as-is when
+            # in band (the LP could not change anything either — its
+            # only choice is which valid slot leads); out of band it is
+            # unrepairable by lead permutation
+            return a.copy() if in_band else None
         out = a.copy()
         INF = np.int64(1) << 40
         N = B + 1  # + virtual node for band-shifting paths
-        for _ in range(256):  # cap >> any observed cycle count
+
+        def arc_views():
+            """(gain, b_from, b_to, cost) over the CURRENT ``out``.
+            The single definition both phases share: the witness
+            lookup below matches on ``cost == C[b, c]``, which is only
+            sound while every consumer computes costs identically."""
             gain = np.where(
                 valid & (out < B),
                 self.w_leader[prow, out] - self.w_follower[prow, out],
                 0,
             ).astype(np.int64)
-            b_from = out[p_arc, 0]
-            b_to = out[p_arc, s_arc]
-            cost = gain[p_arc, 0] - gain[p_arc, s_arc]
+            return (
+                gain,
+                out[p_arc, 0],
+                out[p_arc, s_arc],
+                gain[p_arc, 0] - gain[p_arc, s_arc],
+            )
+
+        def refresh_row(p, gain, b_from, b_to, cost):
+            """Fold one partition's swap into the arc views in
+            O(R + arcs_of_p) — a full rebuild per applied edge is
+            O(P*R) and turns the repair of a scrambled 50k-partition
+            input into seconds of dead numpy."""
+            row = out[p]
+            gain[p] = np.where(
+                valid[p] & (row < B),
+                self.w_leader[p, row] - self.w_follower[p, row],
+                0,
+            )
+            lo_i = np.searchsorted(p_arc, p)
+            hi_i = np.searchsorted(p_arc, p + 1)
+            b_from[lo_i:hi_i] = row[0]
+            b_to[lo_i:hi_i] = row[s_arc[lo_i:hi_i]]
+            cost[lo_i:hi_i] = gain[p, 0] - gain[p, s_arc[lo_i:hi_i]]
+
+        if not in_band:
+            # --- band-repair phase (r4): out-of-band inputs used to
+            # decline to the transportation LP (seconds at 50k
+            # partitions). Each repair unit shifts one lead along the
+            # cheapest broker path from a shed source to an absorbing
+            # sink, reducing total band violation by exactly one; a
+            # path always exists while violations remain, because the
+            # difference to ANY band-feasible arrangement of the same
+            # replica sets decomposes into lead-shift paths whose arcs
+            # are all present in the current arrangement. Optimality
+            # is NOT needed here — the cycle-canceling phase below
+            # restores it from any feasible point — so path costs are
+            # shifted non-negative and searched with plain
+            # Bellman-Ford (the raw arc matrix can hold negative
+            # cycles before canceling).
+            viol = int(
+                np.maximum(lcnt - self.leader_hi, 0).sum()
+                + np.maximum(self.leader_lo - lcnt, 0).sum()
+            )
+            if viol > 2 * N + 16:
+                return None  # grossly out of band: let the LP repair
+            gain = b_from = b_to = cost = None
+            for _unit in range(viol):
+                surplus = lcnt > self.leader_hi
+                deficit = lcnt < self.leader_lo
+                if not surplus.any() and not deficit.any():
+                    break
+                if gain is None:  # per-edge refreshes keep them current
+                    gain, b_from, b_to, cost = arc_views()
+                C = np.full((B, B), INF, dtype=np.int64)
+                np.minimum.at(C, (b_from, b_to), cost)
+                np.fill_diagonal(C, INF)
+                finite = C < INF
+                if not finite.any():
+                    return None
+                shift = max(0, -int(C[finite].min()))
+                Cn = np.where(finite, C + shift, INF)
+                if surplus.any():
+                    src_mask = surplus
+                    dst_mask = lcnt + 1 <= self.leader_hi
+                else:
+                    src_mask = lcnt - 1 >= self.leader_lo
+                    dst_mask = deficit
+                dist = np.where(src_mask, np.int64(0), INF)
+                parent = np.full(B, -1, dtype=np.int64)
+                for _sweep in range(B):
+                    cand = dist[:, None] + Cn
+                    nb = cand.argmin(axis=0)
+                    nd = cand[nb, np.arange(B)]
+                    better = nd < dist
+                    if not better.any():
+                        break
+                    dist = np.where(better, nd, dist)
+                    parent = np.where(better, nb, parent)
+                sinks = np.flatnonzero(dst_mask & (dist < INF))
+                if sinks.size == 0:
+                    return None  # unreachable: decline, LP decides
+                v = int(sinks[np.argmin(dist[sinks])])
+                path = [v]
+                while not src_mask[path[-1]]:
+                    u = int(parent[path[-1]])
+                    if u < 0 or len(path) > B:
+                        return None
+                    path.append(u)
+                path.reverse()  # source ... sink
+                for b, c in zip(path, path[1:]):
+                    hit = np.flatnonzero(
+                        (b_from == b) & (b_to == c) & (cost == C[b, c])
+                    )
+                    if hit.size == 0:
+                        return None  # stale witness: decline
+                    k = int(hit[0])
+                    p, s = int(p_arc[k]), int(s_arc[k])
+                    out[p, 0], out[p, s] = out[p, s], out[p, 0]
+                    lcnt[b] -= 1
+                    lcnt[c] += 1
+                    # refresh the swapped row's arc views so the
+                    # path's later edges see this swap (their
+                    # witnesses stay valid: a shift INTO an
+                    # intermediate broker never removes a partition
+                    # from its led set)
+                    refresh_row(p, gain, b_from, b_to, cost)
+            if (lcnt < self.leader_lo).any() or (
+                lcnt > self.leader_hi
+            ).any():
+                return None  # repair fell short: decline, LP decides
+        for _ in range(256):  # cap >> any observed cycle count
+            gain, b_from, b_to, cost = arc_views()
             C = np.full((N, N), INF, dtype=np.int64)
             np.minimum.at(C, (b_from, b_to), cost)
             np.fill_diagonal(C, INF)  # self-arcs are no-ops

@@ -118,12 +118,14 @@ def test_best_leader_assignment_never_regresses():
 
 def test_reseat_cycle_cancel_matches_lp():
     """The negative-cycle-canceling fast path of the exact reseat must
-    land on the SAME optimum as the transportation LP on every
-    band-feasible input — including adversarially scrambled leadership
-    (random in-band leader swaps), where multi-arc cancel cycles are
-    actually exercised. Measured r4: the canceller replaced a 58 s LP
-    solve on the adv50k certification path, so its exactness is
-    certificate-critical."""
+    land on the SAME optimum as the transportation LP on every input —
+    including adversarially scrambled leadership (random in-partition
+    leader swaps), where multi-arc cancel cycles are actually
+    exercised, and OUT-OF-BAND leadership counts, where the r4
+    band-repair phase runs before canceling (the LP repairs optimally,
+    so the canceller must too). Measured r4: the canceller replaced a
+    58 s LP solve on the adv50k certification path, so its exactness
+    is certificate-critical."""
     rng = np.random.default_rng(3)
     for name in ("decommission", "adversarial", "leader_only"):
         sc, inst = _inst(name)
@@ -133,6 +135,7 @@ def test_reseat_cycle_cancel_matches_lp():
         for trial in range(4):
             a = base.copy()
             if trial:  # scramble: random in-partition leader swaps
+                # (out-of-band results are kept: they exercise repair)
                 for p in rng.choice(
                     inst.num_parts, size=min(inst.num_parts, 40),
                     replace=False,
@@ -143,13 +146,6 @@ def test_reseat_cycle_cancel_matches_lp():
                     if live.size >= 2:
                         s = int(rng.choice(live[1:]))
                         a[p, 0], a[p, s] = a[p, s], a[p, 0]
-                lcnt = np.bincount(
-                    a[inst.rf > 0, 0], minlength=B
-                )[:B]
-                if (lcnt < inst.leader_lo).any() or (
-                    lcnt > inst.leader_hi
-                ).any():
-                    continue  # out-of-band scramble: LP-fallback turf
             fast = inst._reseat_cycle_cancel(a.copy())
             lp = inst._best_leader_lp(a.copy())
             assert fast is not None, f"{name} trial {trial} declined"

@@ -69,6 +69,41 @@ def test_construct_never_infeasible_fuzz(rng):
             assert inst.is_feasible(a), (trial, inst.violations(a))
 
 
+@pytest.mark.parametrize("name", ["scale_out", "leader_only"])
+def test_construct_reseats_without_lp_fallback(name):
+    """The slot-0 pre-seat (kept leaders + the completion's
+    lead-channel placements) must leave the exact reseat's fast
+    cycle-canceller an in-band input, so the constructor never needs
+    the full transportation LP — the r4 fix that took the jumbo's
+    realization from 7.2 s to 0.5 s. A regression (canceller declines,
+    LP path hit) fails loudly here instead of silently costing
+    seconds per constructed solve."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        ProblemInstance,
+    )
+
+    sc, inst = _inst(name)
+    calls = []
+    orig = ProblemInstance._best_leader_lp
+
+    def _spy(self, a):
+        calls.append(1)
+        return orig(self, a)
+
+    ProblemInstance._best_leader_lp = _spy
+    try:
+        a = construct(inst)
+    finally:
+        ProblemInstance._best_leader_lp = orig
+    assert a is not None
+    assert inst.is_feasible(a)
+    assert inst.certify_optimal(a)
+    assert not calls, (
+        "constructed plan fell back to the reseat LP: the slot-0 "
+        "pre-seat left out-of-band leader counts"
+    )
+
+
 def test_mcmf_completion_survives_binding_lead_gates():
     """Plain placements must not consume lead quota: two leaderless
     vacancies forced onto one broker with lead_quota 1 must still all
