@@ -177,6 +177,13 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     else:
         sc = gen.SCENARIOS[name]()
 
+    # the adversarial rows are the at-scale SEARCH-ENGINE benchmark
+    # (VERDICT r3 item 2): the explicit engine knob opts out of the
+    # host-side constructor/reseat races, so the sweep annealer must
+    # close to the bound ladder ON-CHIP. The default (knob-free) path
+    # wins the greedy+reseat race instead — measured separately below
+    # and reported as default_wall_clock_s in the stderr detail.
+    knobs = {"engine": "sweep"} if name in ("adversarial", "adv50k") else {}
     walls = []
     # warm: runs 2..3 reuse the jit cache; report the best warm run —
     # the tunnel-attached TPU shows multi-second scheduler noise between
@@ -185,8 +192,14 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     runs = 3 if warm else 1
     for _ in range(runs):
         t0 = time.perf_counter()
-        res = optimize(solver="tpu", seed=seed, **sc.kwargs)
+        res = optimize(solver="tpu", seed=seed, **knobs, **sc.kwargs)
         walls.append(time.perf_counter() - t0)
+    default_wall = default_proved = None
+    if knobs:
+        t0 = time.perf_counter()
+        res_d = optimize(solver="tpu", seed=seed, **sc.kwargs)
+        default_wall = round(time.perf_counter() - t0, 3)
+        default_proved = res_d.report()["proven_optimal"]
     report = res.report()
     cold, warm_wall = walls[0], min(walls[1:]) if warm else walls[0]
     return {
@@ -219,6 +232,14 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         "objective_ub": report["objective_upper_bound"],
         "brokers": report["brokers"],
         "partitions": report["partitions"],
+        # adversarial rows only: the knob-free path (greedy+reseat
+        # race, no device) on the same instance — the number a default
+        # caller actually sees
+        **(
+            {"default_wall_clock_s": default_wall,
+             "default_proved_optimal": default_proved}
+            if default_wall is not None else {}
+        ),
     }
 
 

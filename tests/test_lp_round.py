@@ -168,6 +168,7 @@ def test_no_signal_keeps_annealing_path(monkeypatch):
     from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
 
     monkeypatch.setattr(eng, "_EXACT_RACE_PARTS", 0)
+    monkeypatch.setattr(eng, "_RESEAT_RACE", False)
     sc = gen.SCENARIOS["demo"]()
     inst = build_instance(sc.current, sc.broker_list, sc.topology)
     assert not eng._caps_bind(inst)
