@@ -346,6 +346,33 @@ def test_adv50k_full_scale_gates():
     assert sc.min_moves_lb == inst.move_lower_bound()
 
 
+def test_adv50k_full_scale_default_certifies_via_reseat():
+    """The FULL-SIZE adv50k default path: the greedy+reseat racer
+    alone produces the certified optimum of the 50k-partition shuffled
+    mixed-RF decommission — host CPU only, no device, a few seconds
+    (the README's 6.4-8.6 s default-path claim rests on this)."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        _BoundsTask,
+        _construct_worker,
+    )
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    sc = gen.SCENARIOS["adv50k"]()
+    inst = build_instance(sc.current, sc.broker_list, sc.topology,
+                          target_rf=sc.target_rf)
+    bounds = _BoundsTask(
+        lambda: (inst.move_lower_bound_exact(), inst.weight_upper_bound())
+    )
+    # the route solve_tpu actually takes for adv50k: past the
+    # aggregation threshold into _construct_worker, whose agg-refusal
+    # fallback dispatches the reseat racer
+    plan, ok = _construct_worker(inst, bounds, reseat_fallback=True)
+    assert ok, "reseat racer failed to certify the full-size adv50k"
+    assert inst._construct_path == "reseat"
+    assert inst.is_feasible(plan)
+    assert inst.move_count(plan) == sc.min_moves_lb
+
+
 def test_adv50k_smoke_solves_proven():
     """The shrunk adv50k config (bench --smoke) keeps the generator
     invariants and is solved feasible + proven by the sweep engine —
