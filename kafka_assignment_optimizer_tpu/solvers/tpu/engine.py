@@ -47,6 +47,13 @@ _PENDING_AOT: set = set()
 # Module-level so tests can pin the race deterministically.
 _CONSTRUCT_WAIT_S = 5.0
 _CONSTRUCT_WAIT_BIG_S = 45.0
+# middle tier, greedy+reseat racer ONLY: at tens of thousands of
+# members the reseat needs ~4-5 s — just past the snappy cap — and the
+# reseat worker always terminates in seconds (greedy + canceller +
+# certify, no LP), so the headroom never stalls a solve; missing the
+# window would buy a cold process a minutes-long first compile
+_CONSTRUCT_WAIT_MID_S = 15.0
+_RESEAT_WAIT_MID_MEMBERS = 20_000
 
 # tiny-instance exact race (VERDICT r3 item 7): below these sizes the
 # exact MILP solves in milliseconds, so a DEFAULTED solve races it like
@@ -188,16 +195,21 @@ def solve_tpu(
         for v in (engine, batch, rounds, sweeps, steps_per_round,
                   t_hi, t_lo)
     )
-    if not multi and (
-        _caps_bind(inst)
-        or inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
-        or inst.agg_effective()
-    ):
+    members = inst._members()[0].size
+    big = members > _instance_mod.AGG_MEMBER_THRESHOLD
+    if not multi and (_caps_bind(inst) or big or inst.agg_effective()):
         reseat_ok = _RESEAT_RACE and not knobs_set
         lp_fut = _BoundsTask(
             lambda: _construct_worker(inst, bounds_fut,
                                       reseat_fallback=reseat_ok)
         )
+        # past the aggregation threshold the constructor (agg MILP +
+        # completion + exact reseat, ~15-20 s) is far cheaper than the
+        # first sweep-executable compile (minutes), so waiting longer
+        # is a net win; below it the snappy cap holds (the constructor
+        # either lands in ~2 s or the annealer should start — its LP
+        # route has no termination guarantee)
+        lp_wait_s = _CONSTRUCT_WAIT_BIG_S if big else _CONSTRUCT_WAIT_S
     elif (
         not multi
         and not knobs_set
@@ -205,19 +217,26 @@ def solve_tpu(
         and 2 * inst.num_brokers * inst.num_parts <= _EXACT_RACE_VARS
     ):
         lp_fut = _BoundsTask(lambda: _exact_worker(inst, bounds_fut))
+        lp_wait_s = _CONSTRUCT_WAIT_S
     elif not multi and not knobs_set and _RESEAT_RACE:
         # slack caps, no symmetry, too big for the exact MILP — the
         # adversarial class. Greedy + exact reseat races the annealer:
         # certified it skips the search entirely; uncertified it still
         # hands the ladder a better warm start than the raw greedy
         lp_fut = _BoundsTask(lambda: _reseat_worker(inst, bounds_fut))
+        lp_wait_s = (
+            _CONSTRUCT_WAIT_MID_S
+            if members > _RESEAT_WAIT_MID_MEMBERS
+            else _CONSTRUCT_WAIT_S
+        )
     else:
         lp_fut = None
+        lp_wait_s = 0.0
     res = _solve_tpu_inner(
         inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
         backend_fut, t0, bounds_fut,
-        cert_min_savings_s, lp_fut, multi,
+        cert_min_savings_s, lp_fut, multi, lp_wait_s,
     )
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
@@ -435,7 +454,7 @@ def _solve_tpu_inner(
     inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
     n_devices, engine, checkpoint, profile_dir, time_limit_s,
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
-    lp_fut=None, multi=False,
+    lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
 ) -> SolveResult:
     tight_fut = None
     timed_out = False
@@ -471,18 +490,15 @@ def _solve_tpu_inner(
 
             Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
         budget = _budget_left(t0, time_limit_s)
-        # adaptive wait: past the aggregation threshold — the same
-        # gate that launches the aggregated-MILP constructor above —
-        # the constructor (agg MILP + completion + exact reseat,
-        # ~15-20 s) is far cheaper than the first sweep-executable
-        # compile (minutes), so waiting longer for it is a net win;
-        # below it the snappy cap holds (the aggregated constructor
-        # either lands in ~2 s or the annealer should start)
-        big = inst._members()[0].size > _instance_mod.AGG_MEMBER_THRESHOLD
-        wait_s = _CONSTRUCT_WAIT_BIG_S if big else _CONSTRUCT_WAIT_S
+        # per-worker adaptive wait, chosen by solve_tpu when it picked
+        # the racer (45 s past the aggregation threshold, a 15 s
+        # middle tier for the mid-size reseat racer, 5 s otherwise)
         try:
             plan, ok = lp_fut.result(
-                timeout=wait_s if budget is None else min(wait_s, budget)
+                timeout=(
+                    lp_wait_s if budget is None
+                    else min(lp_wait_s, budget)
+                )
             )
         except Exception:
             plan, ok = None, False

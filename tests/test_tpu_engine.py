@@ -365,7 +365,14 @@ def test_adv50k_full_scale_default_certifies_via_reseat():
     )
     # the route solve_tpu actually takes for adv50k: past the
     # aggregation threshold into _construct_worker, whose agg-refusal
-    # fallback dispatches the reseat racer
+    # fallback dispatches the reseat racer. Guard the precondition —
+    # if generator drift ever makes aggregation viable here, the call
+    # below would grind the aggregated MILP for minutes; fail fast
+    # with a diagnosis instead
+    assert not inst.agg_construct_viable(), (
+        "adv50k generator drift: aggregation became viable, the "
+        "reseat-fallback route is no longer exercised"
+    )
     plan, ok = _construct_worker(inst, bounds, reseat_fallback=True)
     assert ok, "reseat racer failed to certify the full-size adv50k"
     assert inst._construct_path == "reseat"
