@@ -469,7 +469,12 @@ class ProblemInstance:
             if level >= 1 and 1 not in memo:
                 if getattr(self, "_bounds_cancelled", False):
                     return memo[0]
-                lead = None if big else self._leader_cap_lp(with_lower=True)
+                # past the threshold the scipy LP is off the table, but
+                # the r4 flow fast path stays cheap at any size — so
+                # big instances attempt level 1 flow-only instead of
+                # skipping the tier outright
+                lead = self._leader_cap_lp(with_lower=True,
+                                           flow_only=big)
                 memo[1] = memo[0] if lead is None else min(memo[0], lead)
             if level >= 2 and 2 not in memo:
                 if getattr(self, "_bounds_cancelled", False):
@@ -626,13 +631,12 @@ class ProblemInstance:
         except Exception:
             return None
 
-    def _leader_cap_flow(self, gain, rows, cols, ids, base) -> int | None:
-        """Exact cap-only leader bound on the native min-cost-flow
-        kernel (the fast path of ``_leader_cap_lp``): the transportation
-        polytope is integral, so integer flows reach the identical LP
-        optimum. Returns None (caller falls back to the LP) when the
-        native kernel is unavailable, the gains are non-integral, or
-        the bounds deadline is already spent."""
+    def _flow_prologue(self, gain, rows, cols, ids):
+        """Shared guards + arc extraction for the leader-bound flow
+        fast paths. Returns ``(mcmf, g_int, b_of, nP, pidx)`` or None
+        when the native kernel is unavailable, the bounds deadline is
+        spent, or the gains are non-integral — callers fall back to
+        the scipy LP in every case."""
         try:
             from ..native import mcmf
         except Exception:
@@ -645,8 +649,20 @@ class ProblemInstance:
             return None
         b_of = ids[rows, cols].astype(np.int64)
         up, pidx = np.unique(rows, return_inverse=True)
+        return mcmf, g_int, b_of, up.size, pidx
+
+    def _leader_cap_flow(self, gain, rows, cols, ids, base) -> int | None:
+        """Exact cap-only leader bound on the native min-cost-flow
+        kernel (the fast path of ``_leader_cap_lp``): the transportation
+        polytope is integral, so integer flows reach the identical LP
+        optimum. Returns None (caller falls back to the LP) when the
+        shared prologue declines."""
+        pro = self._flow_prologue(gain, rows, cols, ids)
+        if pro is None:
+            return None
+        mcmf, g_int, b_of, nP, pidx = pro
         ub, bidx = np.unique(b_of, return_inverse=True)
-        nP, nB, n = up.size, ub.size, rows.size
+        nB, n = ub.size, rows.size
         o_b = 1 + nP
         t = o_b + nB
         src = np.concatenate([
@@ -679,7 +695,90 @@ class ProblemInstance:
             return None
         return base + int(-c)
 
-    def _leader_cap_lp(self, with_lower: bool = False) -> int | None:
+    def _leader_cap_flow_lower(self, gain, rows, cols, ids, base,
+                               p_active) -> int | None:
+        """Exact LEVEL-1 leader bound on the native min-cost-flow
+        kernel (the fast path of ``_leader_cap_lp(with_lower=True)``).
+        The slack formulation is still a network: the per-broker
+        zero-gain lead slack y_b is a POOL node any partition (or the
+        source directly, for partitions with no gainful arc) can dump
+        into and that feeds every broker at cost 0; the leader band's
+        lower side becomes a rewarded broker->sink arc of capacity
+        ``leader_lo`` at cost -BIG (BIG > total possible gain, so
+        floors fill with absolute priority), the upper side the
+        residual ``leader_hi - leader_lo`` at cost 0; the total-leads
+        equality is the forced max flow of exactly ``p_active``. The
+        polytope is integral, so the integer flow optimum IS the LP
+        optimum — with none of the IPM-undershoot dual-repair the LP
+        path needs. Returns None (caller falls back to the LP) when
+        the shared prologue declines, the flow comes up short of
+        ``p_active``, or any floor arc goes unsaturated
+        (band-infeasible: the LP verdict decides)."""
+        pro = self._flow_prologue(gain, rows, cols, ids)
+        if pro is None:
+            return None
+        mcmf, g_int, b_of, nP, pidx = pro
+        B = self.num_brokers
+        lo_b = int(self.leader_lo)
+        hi_b = int(self.leader_hi)
+        big = int(g_int.sum()) + 1
+        n = rows.size
+        o_pool = 1 + nP
+        o_b = o_pool + 1
+        t = o_b + B
+        rest = int(p_active) - nP  # partitions with no gainful arc
+        if rest < 0:
+            return None  # inconsistent inputs; let the LP decide
+        src = np.concatenate([
+            np.zeros(nP, np.int64),          # s -> p
+            1 + pidx,                        # p -> broker (gain arcs)
+            1 + np.arange(nP),               # p -> pool (zero-gain)
+            np.zeros(1, np.int64),           # s -> pool (gainless parts)
+            np.full(B, o_pool, np.int64),    # pool -> broker
+            o_b + np.arange(B),              # broker -> t (floor, -BIG)
+            o_b + np.arange(B),              # broker -> t (residual)
+        ])
+        dst = np.concatenate([
+            1 + np.arange(nP),
+            o_b + b_of,
+            np.full(nP, o_pool, np.int64),
+            np.full(1, o_pool, np.int64),
+            o_b + np.arange(B),
+            np.full(B, t, np.int64),
+            np.full(B, t, np.int64),
+        ])
+        cap = np.concatenate([
+            np.ones(nP, np.int64),
+            np.ones(n, np.int64),
+            np.ones(nP, np.int64),
+            np.full(1, rest, np.int64),
+            np.full(B, int(p_active), np.int64),
+            np.full(B, lo_b, np.int64),
+            np.full(B, hi_b - lo_b, np.int64),
+        ])
+        cost = np.concatenate([
+            np.zeros(nP, np.int64),
+            -g_int,
+            np.zeros(nP, np.int64),
+            np.zeros(1, np.int64),
+            np.zeros(B, np.int64),
+            np.full(B, -big, np.int64),
+            np.zeros(B, np.int64),
+        ])
+        try:
+            f, c, af = mcmf(src, dst, cap, cost, 0, t, t + 1)
+        except Exception:
+            return None
+        if f != int(p_active):
+            return None  # band-infeasible or degenerate: LP decides
+        floor_arcs = af[nP + n + nP + 1 + B:nP + n + nP + 1 + 2 * B]
+        filled = int(floor_arcs.sum())
+        if filled != B * lo_b:
+            return None  # a floor went unmet: LP decides
+        return base + int(-(c + big * filled))
+
+    def _leader_cap_lp(self, with_lower: bool = False,
+                       flow_only: bool = False) -> int | None:
         """max_weight with the per-broker leadership constraints modeled
         exactly. Each partition either hands leadership to a member m
         (gain = val[p,m] - s_rm1 over the non-member-leader optimum) or
@@ -692,7 +791,12 @@ class ProblemInstance:
         leader-skew rebalances: under-leading brokers are FORCED to
         take leaderships away from gainful keeps, a loss the cap-only
         model cannot see — but the slack formulation solves ~3x slower,
-        so it is a separate, lazier bound level."""
+        so it is a separate, lazier bound level.
+
+        ``flow_only`` skips the scipy-LP fallback when the native flow
+        fast path declines — for instances past the aggregation
+        threshold, where the LP would grind for minutes but the flow
+        stays sub-second at any size."""
         r = self._leader_vals()
         if r is None:
             return 0
@@ -723,6 +827,17 @@ class ProblemInstance:
             b = self._leader_cap_flow(gain, rows, cols, ids, base)
             if b is not None:
                 return b
+        else:
+            # the slack formulation is a network too (pool node +
+            # floor-priority arcs); same exactness argument, ~25x the
+            # LP's speed at 50k partitions
+            b = self._leader_cap_flow_lower(
+                gain, rows, cols, ids, base, p_active
+            )
+            if b is not None:
+                return b
+        if flow_only:
+            return None  # caller ruled the scipy LP out at this size
         try:
             import scipy.sparse as sp
             from scipy.optimize import linprog

@@ -217,6 +217,12 @@ def test_leader_cap_flow_matches_lp_oracle(rng):
         build_instance,
     )
 
+    # a broken native build would silently turn this into LP-vs-LP —
+    # exactly the vacuous pass the docstring warns about
+    from kafka_assignment_optimizer_tpu.native import mcmf
+
+    assert callable(mcmf)
+
     checked = 0
     for trial in range(12):
         n_b = int(rng.integers(4, 16))
@@ -238,18 +244,32 @@ def test_leader_cap_flow_matches_lp_oracle(rng):
         inst = build_instance(
             Assignment(partitions=parts), brokers, topo
         )
-        flow = inst._leader_cap_lp(with_lower=False)
-        # force the scipy path by disabling the flow fast path
-        orig = ProblemInstance._leader_cap_flow
+        flow0 = inst._leader_cap_lp(with_lower=False)
+        flow1 = inst._leader_cap_lp(with_lower=True)
+        # force the scipy path by disabling the flow fast paths
+        orig0 = ProblemInstance._leader_cap_flow
+        orig1 = ProblemInstance._leader_cap_flow_lower
         ProblemInstance._leader_cap_flow = lambda self, *a, **k: None
+        ProblemInstance._leader_cap_flow_lower = (
+            lambda self, *a, **k: None
+        )
         try:
             inst2 = build_instance(
                 Assignment(partitions=parts), brokers, topo
             )
-            lp = inst2._leader_cap_lp(with_lower=False)
+            lp0 = inst2._leader_cap_lp(with_lower=False)
+            lp1 = inst2._leader_cap_lp(with_lower=True)
         finally:
-            ProblemInstance._leader_cap_flow = orig
-        assert flow == lp, (trial, flow, lp)
+            ProblemInstance._leader_cap_flow = orig0
+            ProblemInstance._leader_cap_flow_lower = orig1
+        assert flow0 == lp0, (trial, flow0, lp0)
+        # level 1: the flow is the exact polytope optimum; the LP path
+        # reports max(primal, repaired dual), which is sound but can
+        # sit slightly ABOVE the optimum — so the flow must never
+        # exceed it, and must stay within the repair slack of it
+        assert lp1 is not None and flow1 is not None, trial
+        assert flow1 <= lp1, (trial, flow1, lp1)
+        assert lp1 - flow1 <= 2, (trial, flow1, lp1)
         checked += 1
     assert checked == 12
 
