@@ -125,6 +125,17 @@ def mcmf(src, dst, cap, cost, s: int, t: int, n_nodes: int):
     completion networks are DAG-layered so this never fires there)."""
     import numpy as np
 
+    # range-check BEFORE the int32 cast: np.ascontiguousarray wraps
+    # silently, and a wrapped cost would make a caller's bound
+    # arithmetic (computed python-side with the unwrapped value)
+    # quietly unsound — the callers all catch and fall back to an
+    # exact LP, so raising here is the safe failure
+    for name, arr in (("cap", np.asarray(cap)), ("cost", np.asarray(cost))):
+        if arr.size and (
+            int(arr.max(initial=0)) > np.iinfo(np.int32).max
+            or int(arr.min(initial=0)) < np.iinfo(np.int32).min
+        ):
+            raise ValueError(f"{name} exceeds the kernel's int32 range")
     src = np.ascontiguousarray(src, dtype=np.int32)
     dst = np.ascontiguousarray(dst, dtype=np.int32)
     cap = np.ascontiguousarray(cap, dtype=np.int32)

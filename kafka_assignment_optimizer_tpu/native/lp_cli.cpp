@@ -625,9 +625,20 @@ struct Solver {
     return -1;  // every demand row satisfied
   }
 
+  int dive_depth = 0;
+  // stack guard: one frame per assigned variable, each holding a Trail
+  // and a dirty vector — tens of thousands of frames approach the
+  // default 8 MB stack. Abort the phase (the exact dfs takes over)
+  // instead of letting a huge aggregated instance kill the process.
+  static constexpr int kMaxDiveDepth = 20000;
+
   void dive() {
     if (out_of_time() || have_best) return;
     if (node_cap && nodes >= node_cap) {
+      phase_aborted = true;
+      return;
+    }
+    if (dive_depth >= kMaxDiveDepth) {
       phase_aborted = true;
       return;
     }
@@ -653,10 +664,13 @@ struct Solver {
     for (int8_t v : {(int8_t)1, (int8_t)0}) {
       Trail tr;
       std::vector<int> dirty;
-      if (assign(var, v, tr, dirty) && propagate(tr, dirty))
+      if (assign(var, v, tr, dirty) && propagate(tr, dirty)) {
+        ++dive_depth;
         dive();
-      else
+        --dive_depth;
+      } else {
         bump_fail_row();
+      }
       undo(tr);
       if (timed_out || phase_aborted || have_best) return;
     }

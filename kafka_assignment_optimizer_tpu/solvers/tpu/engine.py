@@ -138,10 +138,12 @@ def solve_tpu(
     from ...utils.platform import enable_compile_cache, ensure_backend
 
     # a previous solve on this instance may have cancelled straggling
-    # bound workers at its return (or tagged its warm start); this
-    # solve gets a fresh escalation and a clean warm-start tag
+    # bound workers at its return (or tagged its warm start / its
+    # constructor path); this solve gets a fresh escalation, a clean
+    # warm-start tag, and no stale construct_path to mislabel stats
     inst._bounds_cancelled = False
     inst._warm_extends_greedy = False
+    inst._construct_path = None
     enable_compile_cache()
     # backend init costs ~5 s over a tunneled TPU and the host-side
     # workers below (bounds prefetch, plan constructor) don't need the
@@ -337,8 +339,12 @@ def _reseat_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     except Exception:
         pass
     a = inst.best_leader_assignment(a)
+    # record the path unconditionally — an uncertified warm start can
+    # still win final selection (constructed=True in stats), and its
+    # construct_path must then name what actually built it rather
+    # than stay None or a stale value from a previous solve
+    inst._construct_path = "reseat"
     if inst.certify_optimal(a):
-        inst._construct_path = "reseat"
         return a, True
     # mark for the main path: this warm start IS greedy + exact reseat,
     # so recomputing the greedy seed (seconds at 50k partitions) and
@@ -956,6 +962,10 @@ def _solve_tpu_inner(
                         final_cert = "ok_reseat"
                     else:
                         final_cert = "weight_below_ub"
+                        # the reseat is >= the raw champion (its
+                        # internal rank guard): start the polish from
+                        # it instead of discarding the computed work
+                        cand = reseated
         if certified_final is not None:
             best_a = certified_final
             t_polish = time.perf_counter()
