@@ -70,6 +70,7 @@ def _propose_kernel(
     a0_ref,      # [R, TP] int32 original assignment tile
     rf_ref,      # [1, TP] int32
     prh_ref,     # [1, TP] int32 per-partition rack-diversity cap
+    pval_ref,    # [1, TP] int32 1 on real partitions, 0 on lane padding
     wl_ref,      # [B1, TP] int32 leader weights, transposed
     wf_ref,      # [B1, TP] int32 follower weights, transposed
     rackof_ref,  # [B1, 1] int32 broker -> rack index (null -> K)
@@ -90,6 +91,10 @@ def _propose_kernel(
     o_blead_ref,
     o_bats_ref,
     o_prio_ref,
+    # thinning priority maps ([1, B1, LW] blocks, accumulated over the
+    # partition-tile grid axis; LW = 128 lanes, max-folded in XLA) -----
+    o_mout_ref,
+    o_min_ref,
 ):
     B1, TP = wl_ref.shape
     K1 = rcnt_ref.shape[0]
@@ -253,6 +258,31 @@ def _propose_kernel(
     o_bats_ref[0] = b_at_s
     o_prio_ref[0] = prio
 
+    # ---- thinning priority maps (r5 delta engine) --------------------
+    # m_out[b] / m_in[b] = max prio over this chain's proposals whose
+    # out/in token is b — the same values sweep._thin_keep builds with
+    # scatter-max, accumulated here across partition tiles where the
+    # tokens already sit in VMEM. Lane padding is masked (prio -> 0):
+    # padded lanes carry synthetic accepted proposals whose tokens would
+    # otherwise pollute broker 0's in-map.
+    pt = pl.program_id(1)
+
+    @pl.when(pt == 0)
+    def _init_maps():
+        o_mout_ref[...] = jnp.zeros_like(o_mout_ref)
+        o_min_ref[...] = jnp.zeros_like(o_min_ref)
+
+    prio_v = jnp.where(pval_ref[...] > 0, prio, 0.0)  # [1, TP]
+    # tok_out = b_old (= where(is_lsw, b_lead, b_at_s)); tok_in below
+    oh_tin = jnp.where(jnp.broadcast_to(is_lsw, (B1, TP)), oh_ats, oh_new)
+    po = jnp.where(oh_old > 0, jnp.broadcast_to(prio_v, (B1, TP)), 0.0)
+    pi = jnp.where(oh_tin > 0, jnp.broadcast_to(prio_v, (B1, TP)), 0.0)
+    LW = o_mout_ref.shape[2]
+    for c in range(TP // LW):
+        seg = slice(c * LW, (c + 1) * LW)
+        o_mout_ref[0] = jnp.maximum(o_mout_ref[0], po[:, seg])
+        o_min_ref[0] = jnp.maximum(o_min_ref[0], pi[:, seg])
+
 
 def _pad_lanes(x, tp, value):
     """Pad the LAST axis up to a multiple of tp."""
@@ -261,6 +291,9 @@ def _pad_lanes(x, tp, value):
         return x
     widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
     return jnp.pad(x, widths, constant_values=value)
+
+
+_LW = 128  # lane width of the in-kernel map accumulators
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -284,6 +317,7 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
     temp_a = jnp.full((1, 1), temp, jnp.float32)
 
     Pp = aT.shape[-1]
+    pval = (jnp.arange(Pp, dtype=jnp.int32) < P).astype(jnp.int32)[None]
     grid = (N, Pp // tp)
     vm = pltpu.VMEM
 
@@ -293,6 +327,7 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
         in_specs=[
             pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p), memory_space=vm),
             pl.BlockSpec((R, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
             pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
             pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
             pl.BlockSpec((B1, tp), lambda n, p: (0, p), memory_space=vm),
@@ -317,6 +352,10 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
             pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
                          memory_space=vm)
             for _ in range(6)
+        ] + [
+            pl.BlockSpec((1, B1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm)
+            for _ in range(2)
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
@@ -325,12 +364,19 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
             jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
             jax.ShapeDtypeStruct((N, 1, Pp), jnp.int32),
             jax.ShapeDtypeStruct((N, 1, Pp), jnp.float32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.float32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.float32),
         ],
         interpret=interpret,
-    )(aT, a0T, rf_p, prh_p, wlT, wfT, rackof, rlo, rhi, lim, temp_a,
+    )(aT, a0T, rf_p, prh_p, pval, wlT, wfT, rackof, rlo, rhi, lim, temp_a,
       bitsT, cntT, lcntT, rcntT)
-    islsw, s, bnew, blead, bats, prio = (o[:, 0, :P] for o in outs)
-    return islsw, s, bnew, blead, bats, prio
+    islsw, s, bnew, blead, bats, prio = (o[:, 0, :P] for o in outs[:6])
+    # the padded records + lane-folded maps, for the fused thinning path
+    # (ops.thin_pallas); standalone callers ignore them
+    padded = tuple(o[:, 0] for o in outs[:6])
+    m_out = outs[6].max(-1)  # [N, B1]
+    m_in = outs[7].max(-1)
+    return islsw, s, bnew, blead, bats, prio, padded, m_out, m_in
 
 
 def propose_site_pallas(m: ModelArrays, a: jax.Array, bits: jax.Array,
@@ -342,7 +388,7 @@ def propose_site_pallas(m: ModelArrays, a: jax.Array, bits: jax.Array,
     lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(
         jnp.int32
     )[None]
-    islsw, s, bnew, blead, bats, prio = _propose_call(
+    islsw, s, bnew, blead, bats, prio, _pad, _mo, _mi = _propose_call(
         a, bits, cnt, lcnt, rcnt, temp,
         m.a0, m.rf, m.part_rack_hi.astype(jnp.int32),
         jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),

@@ -1,0 +1,430 @@
+"""Pallas TPU kernels: fused conflict-thinning + apply + histogram deltas.
+
+The r5 delta sweep engine (``solvers.tpu.sweep``) eliminated the per-sweep
+full rescoring — and profiling the remaining loop on v5e showed the new
+floor was the conflict-thinning stage itself: XLA lowers the
+priority-map scatter-max and the keep-check gathers of
+``sweep._thin_keep`` to serialized scatter/gather ops (~3 ms of a ~5 ms
+sweep at 8 chains x 10k partitions), and the carried-histogram updates to
+three more [N, P, B] reduction passes (~1.4 ms). This module moves both
+stages into Mosaic where the tokens already sit in VMEM:
+
+- the **proposal kernel** (``ops.propose_pallas``) accumulates the
+  out/in priority maps across its partition-tile grid — the thinning maps
+  cost one masked max over one-hots it already built;
+- the **site finish kernel** here consumes the proposal records plus the
+  folded maps and produces, in one pass: the keep decision, the applied
+  population, and the exact carried-histogram deltas (cnt/lcnt/rcnt) as
+  lane-folded accumulators;
+- the **exchange maps/finish kernels** do the same for the pair-exchange
+  move (leader histogram only — replica and rack totals are
+  exchange-invariant by construction).
+
+Bit-parity contract: every output equals the XLA formulation in
+``sweep._thin_keep`` / ``sweep._apply_site`` / ``sweep._site_hist_deltas``
+/ ``sweep.exchange_thin_apply`` integer-for-integer (float32 priority
+maxima are order-independent), so either scorer path replays the same
+trajectory — asserted via interpret mode in tests/test_sweep.py and
+tests/test_propose_pallas.py.
+
+Reference scope note: the reference solves this model with host-side
+lp_solve (``/root/reference/README.md:135-137``); a device-resident
+parallel-move thinning stage has no upstream counterpart — it is part of
+the TPU-native hot path SURVEY.md §7 step 6 calls for.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import random
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..solvers.tpu.arrays import ModelArrays
+from .propose_pallas import _LW, _TP, _pad_lanes, _propose_call
+
+
+def _fold_add(x, lw):
+    """[rows, TP] -> [rows, lw] by summing lane chunks (TP % lw == 0)."""
+    tp = x.shape[-1]
+    out = x[:, :lw]
+    for c in range(1, tp // lw):
+        out = out + x[:, c * lw:(c + 1) * lw]
+    return out
+
+
+def _site_finish_kernel(
+    a_ref,       # [1, R, TP] int32 candidate tile, partitions in lanes
+    islsw_ref,   # [1, 1, TP] int32 proposal records (padded, lane-major)
+    s_ref,       # [1, 1, TP] int32
+    bnew_ref,    # [1, 1, TP] int32
+    blead_ref,   # [1, 1, TP] int32
+    bats_ref,    # [1, 1, TP] int32
+    prio_ref,    # [1, 1, TP] float32
+    rf_ref,      # [1, TP] int32
+    pval_ref,    # [1, TP] int32 1 on real partitions
+    rackof_ref,  # [B1, 1] int32 broker -> rack (null -> K)
+    mout_ref,    # [B1, N] float32 folded out-priority maps, all chains
+    min_ref,     # [B1, N] float32
+    # outputs -----------------------------------------------------------
+    o_a_ref,     # [1, R, TP] block of [N, R, Pp]
+    o_dcnt_ref,  # [1, B1, LW] int32, accumulated over partition tiles
+    o_dlcnt_ref,  # [1, B1, LW] int32
+    o_drcnt_ref,  # [1, K1, LW] int32
+):
+    B1, TP = mout_ref.shape[0], a_ref.shape[2]
+    K1 = o_drcnt_ref.shape[1]
+    R = a_ref.shape[1]
+    i32 = jnp.int32
+    f32 = jnp.float32
+
+    n = pl.program_id(0)
+    pt = pl.program_id(1)
+
+    @pl.when(pt == 0)
+    def _init():
+        o_dcnt_ref[...] = jnp.zeros_like(o_dcnt_ref)
+        o_dlcnt_ref[...] = jnp.zeros_like(o_dlcnt_ref)
+        o_drcnt_ref[...] = jnp.zeros_like(o_drcnt_ref)
+
+    NN = mout_ref.shape[1]
+    sel = (jax.lax.broadcasted_iota(i32, (1, NN), 1) == n).astype(f32)
+    mo_col = (mout_ref[...] * sel).sum(1, keepdims=True)  # [B1, 1]
+    mi_col = (min_ref[...] * sel).sum(1, keepdims=True)
+
+    is_lsw = islsw_ref[0] > 0   # [1, TP]
+    s = s_ref[0]
+    b_new = bnew_ref[0]
+    b_lead = blead_ref[0]
+    b_at_s = bats_ref[0]
+    prio = jnp.where(pval_ref[...] > 0, prio_ref[0], 0.0)
+
+    tok_out = jnp.where(is_lsw, b_lead, b_at_s)
+    tok_in = jnp.where(is_lsw, b_at_s, b_new)
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+    oh_out = (tok_out == iota_b).astype(i32)
+    oh_in = (tok_in == iota_b).astype(i32)
+
+    # keep: this proposal owns BOTH priority maps (sweep._thin_keep)
+    mo = (oh_out.astype(f32) * mo_col).sum(0, keepdims=True)  # [1, TP]
+    mi = (oh_in.astype(f32) * mi_col).sum(0, keepdims=True)
+    keep = jnp.logical_and(
+        prio > 0, jnp.logical_and(prio == mo, prio == mi)
+    )
+
+    # apply (sweep._apply_site): replace slot s <- b_new; lswap slot 0 <-
+    # promotee, slot s <- old leader
+    a = a_ref[0]  # [R, TP]
+    rows = []
+    for r in range(R):
+        rep_v = jnp.where(s == r, b_new, a[r:r + 1, :])
+        if r == 0:
+            lsw_v = b_at_s
+        else:
+            lsw_v = jnp.where(s == r, b_lead, a[r:r + 1, :])
+        new_v = jnp.where(is_lsw, lsw_v, rep_v)
+        rows.append(jnp.where(keep, new_v, a[r:r + 1, :]))
+    o_a_ref[0] = jnp.concatenate(rows, axis=0)
+
+    # carried-histogram deltas (sweep._site_hist_deltas): one replica
+    # unit per kept replace, one leadership unit per kept leader move
+    live = rf_ref[...] > 0
+    rep = jnp.logical_and(keep, jnp.logical_and(
+        jnp.logical_not(is_lsw), live
+    ))
+    lead_mv = jnp.logical_and(keep, jnp.logical_and(
+        jnp.logical_or(is_lsw, s == 0), live
+    ))
+    rep_b = jnp.broadcast_to(rep, (B1, TP))
+    lead_b = jnp.broadcast_to(lead_mv, (B1, TP))
+    d = oh_in - oh_out
+    lw = o_dcnt_ref.shape[2]
+    o_dcnt_ref[0] += _fold_add(jnp.where(rep_b, d, 0), lw)
+    o_dlcnt_ref[0] += _fold_add(jnp.where(lead_b, d, 0), lw)
+
+    # rack deltas via the broker -> rack one-hot lut
+    r_out = (oh_out * rackof_ref[...]).sum(0, keepdims=True)  # [1, TP]
+    r_in = (oh_in * rackof_ref[...]).sum(0, keepdims=True)
+    iota_k = jax.lax.broadcasted_iota(i32, (K1, TP), 0)
+    dk = (r_in == iota_k).astype(i32) - (r_out == iota_k).astype(i32)
+    rep_k = jnp.broadcast_to(rep, (K1, TP))
+    o_drcnt_ref[0] += _fold_add(jnp.where(rep_k, dk, 0), lw)
+
+
+@functools.partial(jax.jit, static_argnames=("K1", "interpret"))
+def _site_finish_call(a, padded, m_out, m_in, rf, rackof, *, K1: int,
+                      interpret: bool):
+    N, P, R = a.shape
+    B1 = rackof.shape[0]
+    tp = min(_TP, max(128, -(-P // 128) * 128))
+
+    aT = _pad_lanes(jnp.swapaxes(a, 1, 2), tp, B1 - 1)  # [N, R, Pp]
+    rf_p = _pad_lanes(rf[None, :], tp, 1)
+    Pp = aT.shape[-1]
+    pval = (jnp.arange(Pp, dtype=jnp.int32) < P).astype(jnp.int32)[None]
+    recs = [x[:, None, :] for x in padded]  # [N, 1, Pp] each
+    moT = jnp.swapaxes(m_out, 0, 1)  # [B1, N]
+    miT = jnp.swapaxes(m_in, 0, 1)
+
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+    rec_spec = pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
+                            memory_space=vm)
+
+    a_new, d_cnt, d_lcnt, d_rcnt = pl.pallas_call(
+        _site_finish_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm),
+            rec_spec, rec_spec, rec_spec, rec_spec, rec_spec, rec_spec,
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((1, tp), lambda n, p: (0, p), memory_space=vm),
+            pl.BlockSpec((B1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm),
+            pl.BlockSpec((1, B1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm),
+            pl.BlockSpec((1, B1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm),
+            pl.BlockSpec((1, K1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, R, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.int32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.int32),
+            jax.ShapeDtypeStruct((N, K1, _LW), jnp.int32),
+        ],
+        interpret=interpret,
+    )(aT, *recs, rf_p, pval, rackof, moT, miT)
+    a_new = jnp.swapaxes(a_new, 1, 2)[:, :P]
+    return a_new, d_cnt.sum(-1), d_lcnt.sum(-1), d_rcnt.sum(-1)
+
+
+def site_step_pallas(m: ModelArrays, a: jax.Array, cnt, lcnt, rcnt,
+                     key: jax.Array, temp, *, interpret: bool = False):
+    """One full site sweep — propose/accept/thin/apply plus the exact
+    carried-histogram update — through the fused Mosaic path. Drop-in
+    replacement for ``sweep._site_sweep_delta`` (bit-identical outputs,
+    pinned in tests/test_sweep.py)."""
+    N, P, R = a.shape
+    bits = random.bits(key, (N, P, 8), jnp.uint32)
+    lim = jnp.concatenate([m.broker_band, m.leader_band]).astype(
+        jnp.int32
+    )[None]
+    rackof = m.rack_of.astype(jnp.int32)[:, None]
+    K1 = m.rack_lo.shape[0]
+    *_recs, padded, m_out, m_in = _propose_call(
+        a, bits, cnt, lcnt, rcnt, temp,
+        m.a0, m.rf, m.part_rack_hi.astype(jnp.int32),
+        jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
+        jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),
+        rackof,
+        m.rack_lo.astype(jnp.int32)[:, None],
+        m.rack_hi.astype(jnp.int32)[:, None],
+        lim,
+        interpret=interpret,
+    )
+    a_new, d_cnt, d_lcnt, d_rcnt = _site_finish_call(
+        a, padded, m_out, m_in, m.rf, rackof, K1=K1, interpret=interpret
+    )
+    return a_new, cnt + d_cnt, lcnt + d_lcnt, rcnt + d_rcnt
+
+
+# ---------------------------------------------------------------------------
+# exchange move: standalone maps kernel + finish kernel
+# ---------------------------------------------------------------------------
+
+
+def _exch_maps_kernel(
+    tout_ref,  # [1, 1, TP] int32
+    tin_ref,   # [1, 1, TP] int32
+    prio_ref,  # [1, 1, TP] float32 (lane padding carries prio 0)
+    o_mout_ref,  # [1, B1, LW] float32, accumulated
+    o_min_ref,   # [1, B1, LW] float32
+):
+    B1 = o_mout_ref.shape[1]
+    TP = tout_ref.shape[2]
+    i32 = jnp.int32
+    pt = pl.program_id(1)
+
+    @pl.when(pt == 0)
+    def _init():
+        o_mout_ref[...] = jnp.zeros_like(o_mout_ref)
+        o_min_ref[...] = jnp.zeros_like(o_min_ref)
+
+    prio = prio_ref[0]  # [1, TP]
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+    po = jnp.where(tout_ref[0] == iota_b,
+                   jnp.broadcast_to(prio, (B1, TP)), 0.0)
+    pi = jnp.where(tin_ref[0] == iota_b,
+                   jnp.broadcast_to(prio, (B1, TP)), 0.0)
+    lw = o_mout_ref.shape[2]
+    for c in range(TP // lw):
+        seg = slice(c * lw, (c + 1) * lw)
+        o_mout_ref[0] = jnp.maximum(o_mout_ref[0], po[:, seg])
+        o_min_ref[0] = jnp.maximum(o_min_ref[0], pi[:, seg])
+
+
+def _exch_finish_kernel(
+    a_ref,     # [1, R, TP] int32
+    sown_ref,  # [1, 1, TP] int32 own slot
+    both_ref,  # [1, 1, TP] int32 incoming broker
+    tout_ref,  # [1, 1, TP] int32 leadership token out (B = none)
+    tin_ref,   # [1, 1, TP] int32
+    prio_ref,  # [1, 1, TP] float32
+    mout_ref,  # [B1, N] float32 folded maps, all chains
+    min_ref,   # [B1, N] float32
+    o_a_ref,     # [1, R, TP]
+    o_dlcnt_ref,  # [1, B1, LW] int32, accumulated
+):
+    B1, TP = mout_ref.shape[0], a_ref.shape[2]
+    R = a_ref.shape[1]
+    B = B1 - 1
+    i32 = jnp.int32
+    f32 = jnp.float32
+
+    n = pl.program_id(0)
+    pt = pl.program_id(1)
+
+    @pl.when(pt == 0)
+    def _init():
+        o_dlcnt_ref[...] = jnp.zeros_like(o_dlcnt_ref)
+
+    NN = mout_ref.shape[1]
+    sel = (jax.lax.broadcasted_iota(i32, (1, NN), 1) == n).astype(f32)
+    mo_col = (mout_ref[...] * sel).sum(1, keepdims=True)
+    mi_col = (min_ref[...] * sel).sum(1, keepdims=True)
+
+    s_own = sown_ref[0]
+    b_other = both_ref[0]
+    tok_out = tout_ref[0]
+    tok_in = tin_ref[0]
+    prio = prio_ref[0]
+
+    iota_b = jax.lax.broadcasted_iota(i32, (B1, TP), 0)
+    oh_out = (tok_out == iota_b).astype(i32)
+    oh_in = (tok_in == iota_b).astype(i32)
+    mo = (oh_out.astype(f32) * mo_col).sum(0, keepdims=True)
+    mi = (oh_in.astype(f32) * mi_col).sum(0, keepdims=True)
+    # token B bypasses its map (count-invariant swaps are conflict-free)
+    keep = jnp.logical_and(
+        prio > 0,
+        jnp.logical_and(
+            jnp.logical_or(tok_out == B, prio == mo),
+            jnp.logical_or(tok_in == B, prio == mi),
+        ),
+    )
+
+    a = a_ref[0]
+    rows = []
+    for r in range(R):
+        write = jnp.logical_and(keep, s_own == r)
+        rows.append(jnp.where(write, b_other, a[r:r + 1, :]))
+    o_a_ref[0] = jnp.concatenate(rows, axis=0)
+
+    # exact lcnt delta: slot-0 diff of the applied tile (unchanged
+    # partitions contribute a cancelling +1/-1 pair; replica and rack
+    # totals are exchange-invariant and need no update)
+    dl = (rows[0] == iota_b).astype(i32) - (a[0:1, :] == iota_b).astype(
+        i32
+    )
+    o_dlcnt_ref[0] += _fold_add(dl, o_dlcnt_ref.shape[2])
+
+
+def _exch_call(a, s_own, b_other, tok_out, tok_in, prio, B1,
+               *, interpret: bool):
+    N, P, R = a.shape
+    B1 = int(B1)
+    B = B1 - 1
+    tp = min(_TP, max(128, -(-P // 128) * 128))
+
+    aT = _pad_lanes(jnp.swapaxes(a, 1, 2), tp, B)  # [N, R, Pp]
+    sown = _pad_lanes(s_own[:, None, :], tp, 0)
+    both = _pad_lanes(b_other[:, None, :], tp, B)
+    tout = _pad_lanes(tok_out[:, None, :], tp, B)
+    tin = _pad_lanes(tok_in[:, None, :], tp, B)
+    pri = _pad_lanes(prio[:, None, :], tp, 0)  # padded lanes: keep false
+    Pp = aT.shape[-1]
+    grid = (N, Pp // tp)
+    vm = pltpu.VMEM
+    rec_spec = pl.BlockSpec((1, 1, tp), lambda n, p: (n, 0, p),
+                            memory_space=vm)
+
+    m_out, m_in = pl.pallas_call(
+        _exch_maps_kernel,
+        grid=grid,
+        in_specs=[rec_spec, rec_spec, rec_spec],
+        out_specs=[
+            pl.BlockSpec((1, B1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm)
+            for _ in range(2)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.float32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tout, tin, pri)
+    moT = jnp.swapaxes(m_out.max(-1), 0, 1)  # [B1, N]
+    miT = jnp.swapaxes(m_in.max(-1), 0, 1)
+
+    a_new, d_lcnt = pl.pallas_call(
+        _exch_finish_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm),
+            rec_spec, rec_spec, rec_spec, rec_spec, rec_spec,
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((B1, N), lambda n, p: (0, 0), memory_space=vm),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, R, tp), lambda n, p: (n, 0, p),
+                         memory_space=vm),
+            pl.BlockSpec((1, B1, _LW), lambda n, p: (n, 0, 0),
+                         memory_space=vm),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, R, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((N, B1, _LW), jnp.int32),
+        ],
+        interpret=interpret,
+    )(aT, sown, both, tout, tin, pri, moT, miT)
+    return jnp.swapaxes(a_new, 1, 2)[:, :P], d_lcnt.sum(-1)
+
+
+_exch_call_jit = jax.jit(_exch_call, static_argnames=("B1", "interpret"))
+
+
+def exchange_step_pallas(m: ModelArrays, a: jax.Array, cnt, lcnt, rcnt,
+                         key: jax.Array, temp, *,
+                         interpret: bool = False):
+    """One full exchange sweep through the fused Mosaic thinning path.
+    Drop-in replacement for ``sweep._exchange_sweep_delta``
+    (bit-identical outputs). The pair construction (strides, partner
+    rolls, half-deltas via the exchange kernel) stays in
+    ``sweep.propose_exchange``; this replaces its scatter-max thin/apply
+    and the XLA lcnt reduction."""
+    from ..solvers.tpu.sweep import propose_exchange
+    from .propose_pallas import exchange_halves_pallas
+
+    P = a.shape[1]
+    if P < 2:
+        return a, cnt, lcnt, rcnt
+    halves = functools.partial(exchange_halves_pallas,
+                               interpret=interpret)
+    prop = propose_exchange(m, a, key, temp, halves=halves, lcnt=lcnt)
+    a_new, d_lcnt = _exch_call_jit(
+        a, prop.s, prop.b_other, prop.tok_out, prop.tok_in, prop.prio,
+        B1=m.num_brokers + 1, interpret=interpret,
+    )
+    return a_new, cnt, lcnt + d_lcnt, rcnt

@@ -152,6 +152,61 @@ def test_exchange_halves_bit_identical(rng):
                                       err_msg=f"exchange sweep {i}")
 
 
+@pytest.mark.parametrize("temp", [2.0, 0.02])
+def test_site_step_kernel_bit_identical(rng, temp):
+    """The fused thinning path (propose kernel map outputs + site finish
+    kernel, ``ops.thin_pallas``) must reproduce the XLA delta step —
+    applied population AND carried-histogram updates — bit-for-bit."""
+    from kafka_assignment_optimizer_tpu.ops.thin_pallas import (
+        site_step_pallas,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        _site_sweep_delta,
+    )
+
+    inst, m = _instance(rng)
+    a = _chains(m, inst, rng, 5)
+    _f, _r, cnt, lcnt, rcnt = jax.jit(_histograms)(m, a)
+    key = jax.random.PRNGKey(21)
+    ox = jax.jit(
+        lambda a, c, l, r: _site_sweep_delta(m, a, c, l, r, key, temp)
+    )(a, cnt, lcnt, rcnt)
+    op = jax.jit(
+        lambda a, c, l, r: site_step_pallas(m, a, c, l, r, key, temp,
+                                            interpret=True)
+    )(a, cnt, lcnt, rcnt)
+    for name, x, p in zip(("a", "cnt", "lcnt", "rcnt"), ox, op):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(p),
+                                      err_msg=name)
+
+
+@pytest.mark.parametrize("temp", [2.0, 0.02])
+def test_exchange_step_kernel_bit_identical(rng, temp):
+    """The fused exchange thinning path (maps + finish kernels) must
+    reproduce the XLA exchange delta step bit-for-bit."""
+    from kafka_assignment_optimizer_tpu.ops.thin_pallas import (
+        exchange_step_pallas,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        _exchange_sweep_delta,
+    )
+
+    inst, m = _instance(rng, nb=13, npart=37, rf=3, nr=3)
+    a = _chains(m, inst, rng, 5)
+    _f, _r, cnt, lcnt, rcnt = jax.jit(_histograms)(m, a)
+    key = jax.random.PRNGKey(33)
+    ox = jax.jit(
+        lambda a, c, l, r: _exchange_sweep_delta(m, a, c, l, r, key, temp)
+    )(a, cnt, lcnt, rcnt)
+    op = jax.jit(
+        lambda a, c, l, r: exchange_step_pallas(m, a, c, l, r, key, temp,
+                                                interpret=True)
+    )(a, cnt, lcnt, rcnt)
+    for name, x, p in zip(("a", "cnt", "lcnt", "rcnt"), ox, op):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(p),
+                                      err_msg=name)
+
+
 def test_exchange_preserves_counts(rng):
     """The exchange move is count-invariant by construction: per-broker
     and per-rack replica totals must be untouched by any number of
