@@ -143,6 +143,9 @@ def evaluate(
         "leader_changes": moves.leader_changes,
         "objective_weight": weight,
         "objective_upper_bound": inst.weight_upper_bound(level=2),
+        # exact-flow-tier declines (int32 BIG overflow -> LP fallback):
+        # nonzero means the bound above may be the looser tier
+        "flow_bound_declines": getattr(inst, "_flow_big_declines", 0),
         "proven_optimal": feasible and inst.certify_optimal(a),
         "brokers": inst.num_brokers,
         "partitions": inst.num_parts,

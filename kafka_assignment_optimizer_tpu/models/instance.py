@@ -43,12 +43,15 @@ and the demo's 1-move optimum (golden test).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Sequence
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 # guards creation of per-instance memo locks (instances are dataclasses;
 # the lock attribute is created lazily on first bound computation)
@@ -722,6 +725,22 @@ class ProblemInstance:
         lo_b = int(self.leader_lo)
         hi_b = int(self.leader_hi)
         big = int(g_int.sum()) + 1
+        if big > np.iinfo(np.int32).max:
+            # the floor-priority cost -BIG would overflow the kernel's
+            # int32 arc costs; the wrapper would raise, the except
+            # below would swallow it, and past the flow_only threshold
+            # the level-1 tier would SILENTLY degrade to the weaker
+            # level-0 bound. Decline loudly instead (ADVICE r4): count
+            # it on the instance and log, so a tightness loss at scale
+            # is visible in telemetry rather than inferred from bounds.
+            self._flow_big_declines = getattr(
+                self, "_flow_big_declines", 0
+            ) + 1
+            _log.debug(
+                "leader-cap flow bound declined: BIG=%d exceeds int32 "
+                "arc-cost range (falling back to the LP tier)", big,
+            )
+            return None
         n = rows.size
         o_pool = 1 + nP
         o_b = o_pool + 1

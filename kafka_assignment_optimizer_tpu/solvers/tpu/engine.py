@@ -138,11 +138,13 @@ def solve_tpu(
     from ...utils.platform import enable_compile_cache, ensure_backend
 
     # a previous solve on this instance may have cancelled straggling
-    # bound workers at its return (or tagged its warm start / its
-    # constructor path); this solve gets a fresh escalation, a clean
-    # warm-start tag, and no stale construct_path to mislabel stats
+    # bound workers at its return (or tagged its constructor path);
+    # this solve gets a fresh escalation and no stale construct_path to
+    # mislabel stats. (The extends-greedy warm-start marker needs no
+    # reset: it rides in the worker's RESULT tuple, scoped to this
+    # solve's lp_fut — ADVICE r4 closed the cross-solve race a shared
+    # instance flag had here.)
     inst._bounds_cancelled = False
-    inst._warm_extends_greedy = False
     inst._construct_path = None
     enable_compile_cache()
     # backend init costs ~5 s over a tunneled TPU and the host-side
@@ -330,10 +332,15 @@ def _reseat_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     slots). Joins the bounds prefetch before certifying, like every
     constructor worker, so the two threads never duplicate the bound
     computations. An uncertified result is still returned as a warm
-    start — it can only outrank the raw greedy seed it extends."""
+    start — it can only outrank the raw greedy seed it extends.
+
+    Returns ``(plan, certified, extends_greedy)``; the third element
+    rides in the result tuple rather than on the shared instance so a
+    straggling worker from a PREVIOUS solve can never tag the next
+    solve's warm start (ADVICE r4)."""
     a = np.asarray(greedy_seed(inst), dtype=np.int32)
     if not inst.is_feasible(a):
-        return None, False  # greedy is only near-feasible here
+        return None, False, False  # greedy is only near-feasible here
     try:
         bounds_fut.result()
     except Exception:
@@ -345,12 +352,11 @@ def _reseat_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     # than stay None or a stale value from a previous solve
     inst._construct_path = "reseat"
     if inst.certify_optimal(a):
-        return a, True
-    # mark for the main path: this warm start IS greedy + exact reseat,
-    # so recomputing the greedy seed (seconds at 50k partitions) and
-    # ranking against it would be pure waste
-    inst._warm_extends_greedy = True
-    return a, False
+        return a, True, True
+    # extends_greedy marks that this warm start IS greedy + exact
+    # reseat, so the main path skips recomputing the greedy seed
+    # (seconds at 50k partitions) and the rank-vs-greedy comparison
+    return a, False, True
 
 
 def _construct_worker(inst: ProblemInstance, bounds_fut,
@@ -471,6 +477,7 @@ def _solve_tpu_inner(
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
     rounds_run = 0
     lp_warm = None
+    lp_warm_extends = False  # lp_warm is greedy + exact reseat
     # multi-controller SPMD (see solve_tpu): per-process wall-clock
     # budgets would let workers diverge — in front of collectives
     # (deadlock) or at the final bound joins (disagreeing plans) — so
@@ -498,14 +505,18 @@ def _solve_tpu_inner(
         budget = _budget_left(t0, time_limit_s)
         # per-worker adaptive wait, chosen by solve_tpu when it picked
         # the racer (45 s past the aggregation threshold, a 15 s
-        # middle tier for the mid-size reseat racer, 5 s otherwise)
+        # middle tier for the mid-size reseat racer, 5 s otherwise).
+        # Tolerant unpack: the reseat racer returns a third
+        # extends-greedy element; the other workers (and test doubles)
+        # return plain (plan, ok)
         try:
-            plan, ok = lp_fut.result(
+            plan, ok, *rest = lp_fut.result(
                 timeout=(
                     lp_wait_s if budget is None
                     else min(lp_wait_s, budget)
                 )
             )
+            lp_warm_extends = bool(rest and rest[0])
         except Exception:
             plan, ok = None, False
         if ok:
@@ -565,10 +576,7 @@ def _solve_tpu_inner(
         # (greedy + exact reseat, returned uncertified), reuse it
         # directly instead of recomputing the greedy repair — the
         # extension can only outrank what it extends.
-        warm_extends = (
-            lp_warm is not None
-            and getattr(inst, "_warm_extends_greedy", False)
-        )
+        warm_extends = lp_warm is not None and lp_warm_extends
         a_seed = lp_warm if warm_extends else greedy_seed(inst)
         assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
             "seed left unfilled slots"
@@ -792,7 +800,7 @@ def _solve_tpu_inner(
                 # of the ladder with its certified plan
                 if lp_fut is not None and lp_fut.done():
                     try:
-                        plan, ok = lp_fut.result()
+                        plan, ok, *_rest = lp_fut.result()
                     except Exception:
                         plan, ok = None, False
                     if ok:
@@ -1017,7 +1025,7 @@ def _solve_tpu_inner(
                 # the last of it
                 budget = _budget_left(t0, time_limit_s)
                 try:
-                    plan, _ok = lp_fut.result(
+                    plan, _ok, *_rest = lp_fut.result(
                         timeout=10.0 if budget is None else budget
                     )
                 except Exception:
@@ -1114,6 +1122,12 @@ def _solve_tpu_inner(
             ),
             # present only when the lazy LP bound was actually evaluated
             "weight_ub": inst.best_known_weight_ub(),
+            # times the exact leader-cap flow tier declined (BIG over
+            # int32 arc-cost range) and fell back to the LP — a silent
+            # bound-tightness loss at scale unless surfaced here
+            "flow_bound_declines": getattr(
+                inst, "_flow_big_declines", 0
+            ),
             "proved_optimal": proved_optimal,
             "time_limit_s": time_limit_req,
             "steps_per_round": steps_per_round,

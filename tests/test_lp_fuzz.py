@@ -52,6 +52,11 @@ from kafka_assignment_optimizer_tpu.solvers.lp import (
 )
 from kafka_assignment_optimizer_tpu.solvers.milp import solve_milp
 
+# soak tier (VERDICT r4 item 5): differential fuzz + certificate soak
+# are release gates, not commit gates — excluded from the default run
+# (pyproject addopts -m "not soak"); run with -m soak / -m ""
+pytestmark = pytest.mark.soak
+
 SOAK = int(os.environ.get("KAO_SOAK", "1"))
 
 

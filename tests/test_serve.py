@@ -149,6 +149,35 @@ def test_submit_busy_returns_503():
     assert out["report"]["feasible"]
 
 
+def test_evaluate_succeeds_while_solve_holds_lock():
+    """VERDICT r4 item 8: audits are host-only and hold their own lock,
+    so a long device solve (simulated by holding _SOLVE_LOCK) must not
+    503 an /evaluate — and a saturated auditor still sheds."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+    from kafka_assignment_optimizer_tpu.serve import handle_evaluate
+
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "plan": demo_assignment().to_dict(),
+    }
+    assert srv_mod._SOLVE_LOCK.acquire(timeout=5)  # a long solve runs
+    try:
+        out = handle_evaluate(payload, lock_wait_s=0.2)
+        assert out["feasible"] is False  # references removed broker 19
+    finally:
+        srv_mod._SOLVE_LOCK.release()
+    # the audit lock itself still saturates with 503
+    assert srv_mod._AUDIT_LOCK.acquire(timeout=5)
+    try:
+        with pytest.raises(ApiError) as ei:
+            handle_evaluate(payload, lock_wait_s=0.2)
+        assert ei.value.status == 503
+    finally:
+        srv_mod._AUDIT_LOCK.release()
+
+
 def test_submit_server_caps_time_limit():
     """The service injects its max solve budget; a client may tighten
     the limit but never exceed it."""

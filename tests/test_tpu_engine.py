@@ -310,6 +310,7 @@ def test_adversarial_scenario_is_constructor_proof():
     assert r.solve.objective == ex.solve.objective
 
 
+@pytest.mark.soak
 def test_adversarial_full_scale_gates():
     """The FULL-SIZE adversarial instance (256 brokers / 10k
     partitions) keeps the same gate profile — no solve here, just the
@@ -326,6 +327,7 @@ def test_adversarial_full_scale_gates():
     assert sc.min_moves_lb == inst.move_lower_bound()
 
 
+@pytest.mark.soak
 def test_adv50k_full_scale_gates():
     """The FULL-SIZE adv50k instance (512 brokers / 50k partitions,
     149,600 replica slots) keeps the constructor-proof gate profile at
@@ -346,6 +348,7 @@ def test_adv50k_full_scale_gates():
     assert sc.min_moves_lb == inst.move_lower_bound()
 
 
+@pytest.mark.soak
 def test_adv50k_full_scale_default_certifies_via_reseat():
     """The FULL-SIZE adv50k default path: the greedy+reseat racer
     alone produces the certified optimum of the 50k-partition shuffled
@@ -373,7 +376,8 @@ def test_adv50k_full_scale_default_certifies_via_reseat():
         "adv50k generator drift: aggregation became viable, the "
         "reseat-fallback route is no longer exercised"
     )
-    plan, ok = _construct_worker(inst, bounds, reseat_fallback=True)
+    plan, ok, *_rest = _construct_worker(inst, bounds,
+                                         reseat_fallback=True)
     assert ok, "reseat racer failed to certify the full-size adv50k"
     assert inst._construct_path == "reseat"
     assert inst.is_feasible(plan)
