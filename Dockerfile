@@ -5,9 +5,14 @@
 FROM python:3.12-slim
 
 # g++ for the self-building native backends (exact C++ B&B + the
-# bundled lp_solve-compatible CLI)
+# bundled lp_solve-compatible CLI); lp-solve is the REAL lp_solve 5.5
+# CLI — the reference's actual solver (README.md:135-137) — so
+# --solver=lp_solve runs the genuine binary in this image (a system
+# lp_solve on PATH takes precedence over the bundled work-alike), and
+# tests/test_lp_solve_cli.py::test_real_lp_solve_binary_parity
+# executes against it (it skips where the binary is absent)
 RUN apt-get update \
-    && apt-get install -y --no-install-recommends g++ \
+    && apt-get install -y --no-install-recommends g++ lp-solve \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app

@@ -17,6 +17,7 @@ import json
 import os
 import subprocess
 import sys
+import pytest
 from pathlib import Path
 
 BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
@@ -77,6 +78,7 @@ def test_failure_still_prints_parsable_line():
     assert "platform" in line
 
 
+@pytest.mark.soak
 def test_default_run_embeds_full_results_table():
     """The driver's default invocation must evidence EVERY scenario in
     the single stdout line (VERDICT r2 item 3): a compact scenarios

@@ -10,6 +10,7 @@ mesh the engines build covers the global device view either way.
 from __future__ import annotations
 
 import jax
+import pytest
 
 from kafka_assignment_optimizer_tpu.parallel.distributed import (
     init_distributed,
@@ -66,6 +67,7 @@ def test_mesh_spans_global_devices():
     assert list(mesh.devices.flat) == jax.devices()
 
 
+@pytest.mark.soak
 def test_two_process_distributed_solve_matches_single_process():
     """VERDICT r3 item 4: actually EXECUTE the multi-host path. Two
     local processes form a real jax.distributed cluster (CPU backend,

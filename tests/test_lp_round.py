@@ -195,6 +195,7 @@ def test_tiny_default_solve_races_exact_milp():
     assert not r2.solve.stats["constructed"]
 
 
+@pytest.mark.soak
 def test_big_asymmetric_skips_futile_constructor_race(monkeypatch):
     """Past the unaggregated-LP size, an instance the aggregated
     formulation would refuse (``agg_construct_viable`` False) has NO

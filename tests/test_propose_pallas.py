@@ -63,6 +63,7 @@ def test_proposals_bit_identical(rng, temp):
         np.testing.assert_array_equal(x, p, err_msg=f)
 
 
+@pytest.mark.soak
 def test_sweep_trajectory_bit_identical_with_kernel(rng):
     """Full sweeps through thin_apply: the applied population must be
     byte-equal between the XLA and kernel proposal paths."""
@@ -107,6 +108,7 @@ def test_unequal_racks_and_rf1_partitions(rng):
     )
 
 
+@pytest.mark.soak
 def test_exchange_halves_bit_identical(rng):
     """The exchange-halves kernel reproduces the XLA reference exactly,
     and the full exchange sweep is byte-equal between paths."""
@@ -207,6 +209,7 @@ def test_exchange_step_kernel_bit_identical(rng, temp):
                                       err_msg=name)
 
 
+@pytest.mark.soak
 def test_exchange_preserves_counts(rng):
     """The exchange move is count-invariant by construction: per-broker
     and per-rack replica totals must be untouched by any number of

@@ -310,6 +310,7 @@ def test_sweep_solver_pallas_scorer_bit_identical(rng):
     np.testing.assert_array_equal(c_x, c_p)
 
 
+@pytest.mark.soak
 def test_sweep_pallas_scorer_inside_shard_map(rng):
     """Regression for the r2 TPU bench crash: pallas_call's plain
     ShapeDtypeStruct out_shapes have no vma annotation, which

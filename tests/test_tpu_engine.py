@@ -59,6 +59,7 @@ def test_xla_scorer_matches_numpy_oracle(rng):
         assert int(s.weight) == inst.preservation_weight(a)
 
 
+@pytest.mark.soak
 def test_incremental_deltas_track_full_score(rng):
     """After thousands of accepted moves of all three types, the chain's
     running (w, pen, counts) must equal a from-scratch rescoring."""
@@ -172,6 +173,7 @@ def test_time_limit_is_honored(rng):
     assert wall < 6.0, wall
 
 
+@pytest.mark.soak
 def test_no_time_limit_runs_all_rounds(rng):
     current, brokers, topo = random_cluster(rng, 12, 24, 2, 2, drop=1)
     res = optimize(current=current, broker_list=brokers, topology=topo,
@@ -202,6 +204,7 @@ def test_mesh_size_invariance(rng):
         assert res.solve.objective == exact.solve.objective, (n_dev, rep)
 
 
+@pytest.mark.soak
 def test_mesh_size_invariance_sweep_engine(rng):
     """Same pin for the sweep engine (the at-scale path): forced
     engine='sweep' across mesh sizes stays feasible and within one move
@@ -222,6 +225,7 @@ def test_mesh_size_invariance_sweep_engine(rng):
         assert res.solve.objective >= exact.solve.objective - 1, (n_dev, rep)
 
 
+@pytest.mark.soak
 def test_sweep_infeasible_falls_back_to_chain(monkeypatch):
     """Ultra-tight instance (exact rack bands + per-partition diversity
     1 at RF=4 over 5 racks) that defeats the sweep engine's parallel
