@@ -1,0 +1,166 @@
+"""Multi-chip scaling-benefit curve (VERDICT r4 item 6).
+
+The driver's ``dryrun_multichip`` proves the sharded solve COMPILES,
+EXECUTES, and delivers ICI migration on an N-device mesh; this script
+measures what extra devices BUY. On the virtual 8-device CPU mesh
+(the same no-cluster strategy the test suite uses), it runs the sweep
+engine on the adversarial instance — the one benchmark class where the
+constructors refuse and search quality is the product — at FIXED
+per-chain sweep budget for n_devices in {1, 2, 4, 8}, and records the
+population-best objective/moves per device count.
+
+The mesh axis is candidate-batch data parallelism: devices multiply
+CHAINS (independent annealing trajectories + once-per-snapshot ICI
+best-migration), not partitions, so the expected benefit is a better
+best-of-population at ~constant wall per sweep on real hardware (each
+chip anneals its own chains; the only cross-chip traffic is the few-KB
+winner broadcast). On this 1-core CPU host the virtual devices
+timeshare, so wall grows with devices here — the quality column is the
+hardware-independent signal, the wall column is NOT what a v5e-8 would
+show (see docs/DESIGN.md).
+
+Usage: ``python bench_multichip.py [--sweeps N] [--chains-per-device N]
+[--smoke]`` — prints one JSON object; the driver-independent artifact
+is committed as ``MULTICHIP_CURVE_r05.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    # short-budget regime on purpose: extra devices buy quality exactly
+    # when the per-chain budget does NOT saturate the instance; a
+    # budget where 2 chains already hit the plateau shows a flat curve
+    ap.add_argument("--sweeps", type=int, default=32)
+    ap.add_argument("--chains-per-device", type=int, default=2)
+    ap.add_argument("--scramble-seed", type=int, default=0,
+                    help="RNG seed for the leadership scramble")
+    ap.add_argument("--smoke", action="store_true", default=True,
+                    help="smoke-sized adversarial instance (default: "
+                         "the full 10k-partition instance needs a real "
+                         "accelerator to finish in reasonable time)")
+    ap.add_argument("--full", dest="smoke", action="store_false")
+    args = ap.parse_args()
+
+    # force the virtual CPU mesh BEFORE jax initializes. A site plugin
+    # can force-register an accelerator platform and win over the env
+    # var (tests/conftest.py documents the same issue), so pin via
+    # jax.config as well and assert — a curve silently measured on one
+    # real chip sliced four ways would be meaningless.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu" and jax.device_count() == 8, (
+        f"need the 8-device CPU mesh, got {jax.device_count()} "
+        f"{jax.default_backend()} device(s)"
+    )
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.parallel.mesh import (
+        best_of,
+        make_mesh,
+        solve_on_mesh,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+    from kafka_assignment_optimizer_tpu.solvers.tpu.arrays import (
+        geometric_temps,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    kw = (
+        dict(n_brokers=48, n_topics_low=16, n_topics_high=14,
+             parts_per_topic=20)
+        if args.smoke else {}
+    )
+    sc = gen.SCENARIOS["adversarial"](**kw)
+    inst = build_instance(sc.current, sc.broker_list, sc.topology,
+                          sc.target_rf)
+    m = arrays.from_instance(inst)
+    # the greedy seed is already move-optimal on this class (that is
+    # what the reseat racer exploits), so a curve from it is flat at
+    # every device count — there is nothing left for the search to
+    # buy. Scramble LEADERSHIP instead: roll each partition's slot
+    # order by a random amount. Membership — and with it the replica
+    # move count — is unchanged, but leader counts skew out of band
+    # and preservation weight drops, so the population must both
+    # repair feasibility and re-earn weight: the regime where
+    # independent chains + ICI migration show their value.
+    seed = np.asarray(greedy_seed(inst)).copy()
+    rng = np.random.default_rng(args.scramble_seed)
+    for p in range(inst.num_parts):
+        r = int(inst.rf[p])
+        seed[p, :r] = np.roll(seed[p, :r], int(rng.integers(0, r)))
+    seed_w = int(inst.preservation_weight(seed))
+    seed = jnp.asarray(seed, jnp.int32)
+    temps = geometric_temps(2.0, 0.02, args.sweeps)
+    ub = inst.weight_upper_bound()
+    lb = inst.move_lower_bound_exact()
+
+    rows = []
+    for n_dev in (1, 2, 4, 8):
+        mesh = make_mesh(n_dev)
+        t0 = time.perf_counter()
+        _st, pop_a, pop_k, _curve = solve_on_mesh(
+            m, seed, jax.random.PRNGKey(7), mesh,
+            chains_per_device=args.chains_per_device,
+            rounds=args.sweeps, steps_per_round=1,
+            engine="sweep", temps=temps,
+        )
+        best_a, best_k = best_of(pop_a, pop_k)
+        wall = time.perf_counter() - t0
+        best_np = np.asarray(best_a)
+        rows.append({
+            "n_devices": n_dev,
+            "chains_total": n_dev * args.chains_per_device,
+            "wall_s": round(wall, 2),
+            "objective": int(inst.preservation_weight(best_np)),
+            "moves": int(inst.move_count(best_np)),
+            "feasible": bool(inst.is_feasible(best_np)),
+        })
+        print(f"[multichip] {rows[-1]}", file=sys.stderr)
+
+    out = {
+        "scenario": sc.name,
+        "smoke": args.smoke,
+        "brokers": inst.num_brokers,
+        "partitions": inst.num_parts,
+        "sweeps": args.sweeps,
+        "chains_per_device": args.chains_per_device,
+        "seed": "greedy + per-partition leadership scramble",
+        "seed_weight": seed_w,
+        "weight_upper_bound": int(ub),
+        "move_lower_bound": int(lb),
+        "platform": jax.devices()[0].platform,
+        "note": (
+            "virtual 8-device CPU mesh on a 1-core host: devices "
+            "timeshare, so wall_s grows with n_devices HERE; on a real "
+            "v5e-8 each device anneals its chains concurrently and "
+            "wall stays ~flat while quality follows this curve"
+        ),
+        "curve": rows,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

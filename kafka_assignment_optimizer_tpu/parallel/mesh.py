@@ -153,11 +153,16 @@ def init_sweep_state(
     a = jnp.asarray(a_seed, jnp.int32)
     k0, mv0 = _seed_rank_fn()(a, m)
     n_parts, n_slots = a.shape
-    tile_a = jnp.broadcast_to(a, (n_dev, n, n_parts, n_slots))
+    # host-side numpy tiling: the eager jnp broadcast/full ops each
+    # compile a tiny executable, and over a tunneled TPU every compile
+    # costs a ~0.5 s remote round-trip (r5 cold-start profile); numpy
+    # views cost nothing and device_put ships them without compiling
+    a_np = np.asarray(a)
+    tile_a = np.broadcast_to(a_np, (n_dev, n, n_parts, n_slots))
     state = (
         tile_a,
-        jnp.full((n_dev, n), k0, k0.dtype),
-        jnp.full((n_dev, n), mv0, jnp.int32),
+        np.full((n_dev, n), np.asarray(k0), np.asarray(k0).dtype),
+        np.full((n_dev, n), np.asarray(mv0), np.int32),
         tile_a,
         jax.random.split(key, n_dev),
     )

@@ -42,10 +42,13 @@ def u01(bits):
 
 
 def geometric_temps(t_hi: float, t_lo: float, n: int) -> jax.Array:
-    """The shared annealing temperature ladder."""
-    return jnp.asarray(
-        t_hi * (t_lo / t_hi) ** (jnp.arange(n) / max(n - 1, 1)), jnp.float32
-    )
+    """The shared annealing temperature ladder. Built in numpy: each
+    eager jnp op here would compile its own tiny executable, and over a
+    tunneled TPU every one of those costs a ~0.5 s round-trip to the
+    remote compiler — measured r5, the eager setup ops were ~6 s of a
+    ~30 s cold solve."""
+    ladder = t_hi * (t_lo / t_hi) ** (np.arange(n) / max(n - 1, 1))
+    return jnp.asarray(ladder.astype(np.float32))
 
 
 @jax.tree_util.register_dataclass

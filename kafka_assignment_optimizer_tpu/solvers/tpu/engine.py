@@ -528,6 +528,7 @@ def _run_ladder(
     inst, m, mesh, chains_per_device, rounds, steps_per_round, engine,
     scorer, chunks, seed_dev, key, sweep_state, lp_fut, bounds_fut,
     multi, cert_min_savings_s, t0, time_limit_s, profile_dir,
+    polish_starter=None,
 ) -> _LadderResult:
     """Stage 4 — the chunked annealing ladder: dispatch each schedule
     chunk to the mesh, then do the boundary work between chunks — adopt
@@ -684,6 +685,18 @@ def _run_ladder(
                                 break
                     if r.certified_a is not None:
                         break
+                    if do_cert and polish_starter is not None:
+                        # a certificate check ran and did NOT certify:
+                        # first evidence this instance may need the
+                        # steepest-descent polish — start its AOT
+                        # compile now so it overlaps the remaining
+                        # chunks. Deferred until here (r5) because the
+                        # certify-first design means most at-scale
+                        # solves never polish, and on few-core hosts an
+                        # eager compile thread STEALS the cpu the main
+                        # compile needs (measured: the two ~20 s
+                        # compiles serialize and double the cold start).
+                        polish_starter()
                     if engine != "sweep":
                         seed_dev = jnp.asarray(pa[int(np.argmax(pk))])
             if deadline is not None and time.perf_counter() > deadline:
@@ -1043,37 +1056,46 @@ def _solve_tpu_inner(
     )
     if not chunks:
         polish_jit = None  # device path never imported (certified)
-    # overlap the polish compile with the annealing ladder: the
-    # steepest-descent executable costs ~16 s to build at a fresh
-    # shape, and paying that AFTER the last chunk serializes it onto
-    # the critical path of every non-early-stopped solve. The AOT
-    # handle is joined (not just fire-and-forgotten) at final
-    # selection and the compiled object executed directly, so the win
-    # does not depend on the persistent compile cache and the main
-    # thread never races a duplicate compile of the same executable.
-    # The _PENDING_AOT token lets a long-lived service know a daemon
-    # compile may still be in flight (a timed-out solve abandons the
-    # join) before it drops jit caches.
-    def _aot_polish():
-        token = object()
-        _PENDING_AOT.add(token)
-        try:
-            return polish_jit.lower(m, seed_dev).compile()
-        finally:
-            _PENDING_AOT.discard(token)
+    # the polish AOT compile is LAZY (r5): the certify-first design
+    # means most at-scale solves never run the steepest-descent polish,
+    # and eagerly compiling its ~20 s executable on a daemon thread
+    # stole the cpu the sweep-executable compile needs on few-core
+    # hosts (measured: the two compiles serialized and doubled the
+    # adversarial cold start, 18 s -> 34 s). The starter fires at the
+    # first FAILED boundary certificate — the earliest evidence the
+    # polish may actually run — so the compile still overlaps the
+    # remaining chunks; a solve whose first check is the final one
+    # compiles inline there instead. The AOT handle is joined (not
+    # fire-and-forgotten) and the compiled object executed directly;
+    # the _PENDING_AOT token lets a long-lived service know a daemon
+    # compile may still be in flight before it drops jit caches.
+    polish_fut_box: list = []
 
-    polish_fut = _BoundsTask(_aot_polish) if chunks else None
+    def _start_polish_aot():
+        if polish_fut_box:
+            return  # idempotent: one compile thread at most
+        def _aot_polish():
+            token = object()
+            _PENDING_AOT.add(token)
+            try:
+                return polish_jit.lower(m, seed_dev).compile()
+            finally:
+                _PENDING_AOT.discard(token)
+
+        polish_fut_box.append(_BoundsTask(_aot_polish))
+
     if chunks:
         lad = _run_ladder(
             inst, m, mesh, chains_per_device, rounds, steps_per_round,
             engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
             bounds_fut, multi, cert_min_savings_s, t0, time_limit_s,
-            profile_dir,
+            profile_dir, polish_starter=_start_polish_aot,
         )
     else:
         # constructed fast path: the ladder never runs, and calling into
         # it would import device-adjacent modules this path avoids
         lad = _LadderResult(scorer=scorer)
+    polish_fut = polish_fut_box[0] if polish_fut_box else None
     pop_a, pop_k = lad.pop_a, lad.pop_k
     scorer, pallas_fallback = lad.scorer, lad.pallas_fallback
     tight_fut = lad.tight_fut
