@@ -71,8 +71,18 @@ def request_schema() -> dict:
                             "minimum, objective weight vs its provable "
                             "upper bound, proven_optimal",
             },
-            "GET /healthz": "service status, available solvers, platform",
-            "GET /metrics": "Prometheus text counters (kao_*)",
+            "POST /warmup": {
+                "request": "{'shapes': [{'brokers', 'partitions', "
+                           "'rf'?, 'racks'?}, ...], 'engine'?: "
+                           "'sweep'|'chain'} — precompile executables "
+                           "for these cluster shapes (docs/BUCKETING.md)",
+                "response": "per-shape bucket, wall clock, and compile "
+                            "counters; already_warm when cached",
+            },
+            "GET /healthz": "service status, available solvers, "
+                            "platform, executable-cache + queue state",
+            "GET /metrics": "Prometheus text counters (kao_*, incl. "
+                            "kao_cache_* and kao_queue_*)",
             "GET /schema": "this document",
         },
         "example": {

@@ -233,10 +233,15 @@ def _propose_kernel(
     dw = jnp.where(is_lsw, dw_lsw, dw_rep)
     dpen = jnp.where(is_lsw, dpen_lsw, dpen_rep)
     # pure i1 logic, not a select of two bool vectors — a bool-typed
-    # select materializes i8 operands and Mosaic cannot truncate i8->i1
-    legal = jnp.logical_or(
-        jnp.logical_and(is_lsw, rf > 1),
-        jnp.logical_and(jnp.logical_not(is_lsw), legal_rep),
+    # select materializes i8 operands and Mosaic cannot truncate i8->i1.
+    # rf > 0 mirrors sweep.propose_site: bucket-padded rows must never
+    # win a thinning token (their apply is a no-op).
+    legal = jnp.logical_and(
+        jnp.logical_or(
+            jnp.logical_and(is_lsw, rf > 1),
+            jnp.logical_and(jnp.logical_not(is_lsw), legal_rep),
+        ),
+        rf > 0,
     )
     delta = (SCALE_W * dw - LAMBDA * dpen).astype(f32)
 

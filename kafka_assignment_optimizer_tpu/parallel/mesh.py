@@ -26,14 +26,35 @@ slice, or the CPU test mesh
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..solvers.tpu.arrays import ModelArrays
+from ..solvers.tpu.bucket import STATS as _CACHE_STATS
 
 AXIS = "data"
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions. Newer jax exposes it at the
+    top level with varying-manual-axes checking (``check_vma``, which the
+    Pallas out_shapes defeat — see the call site); older jax (0.4.x) has
+    only ``jax.experimental.shard_map`` whose equivalent knob is
+    ``check_rep``. Either way the explicit out_specs carry the contract."""
+    top = getattr(jax, "shard_map", None)
+    if top is not None:
+        return top(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -51,6 +72,82 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 # instances must not accumulate executables forever.
 _COMPILED: dict[tuple, object] = {}
 _COMPILED_MAX = 16
+# the serve queue runs solves on several worker threads: the LRU
+# refresh (get-then-pop) and eviction must be atomic or a concurrent
+# same-key refresh raises KeyError mid-solve
+_COMPILED_LOCK = threading.Lock()
+
+
+# AOT executable cache: the jitted solvers above are further specialized
+# by argument SHAPES (jax.jit's internal keying) — with shape bucketing
+# (solvers.tpu.bucket) those shapes are canonical bucket shapes, so an
+# explicit (solver key, arg-shape signature) -> compiled-executable LRU
+# makes warmth observable (hit/miss/compile-seconds counters feed
+# /metrics and the bench JSON) and lets a warm solve dispatch the
+# compiled object directly. Bounded like _COMPILED; on any AOT
+# lower/compile/call failure the jitted function itself is the fallback.
+_EXECUTABLES: OrderedDict[tuple, object] = OrderedDict()
+_EXECUTABLES_MAX = 32
+# serve.py drains its solve queue with several worker threads; the LRU
+# bookkeeping (get+move_to_end / insert+evict) must be atomic. Compiles
+# and executions run OUTSIDE the lock — only the dict ops are guarded.
+_EXECUTABLES_LOCK = threading.Lock()
+
+
+def clear_exec_cache() -> None:
+    """Drop the AOT executable LRU (long-lived services pair this with
+    ``jax.clear_caches()`` maintenance)."""
+    with _EXECUTABLES_LOCK:
+        _EXECUTABLES.clear()
+
+
+def _arg_signature(args) -> tuple:
+    return tuple(
+        (tuple(x.shape), str(x.dtype))
+        for x in jax.tree_util.tree_leaves(args)
+    )
+
+
+def _lower_and_compile(fn, args):
+    """One XLA compile (AOT lower + compile). A separate function so
+    tests can monkeypatch it to count real compilations."""
+    return fn.lower(*args).compile()
+
+
+def _dispatch(fn, solver_key: tuple, args: tuple):
+    """Run the solver through the executable cache: reuse the compiled
+    executable for this (solver, shapes) key, compile-and-cache on first
+    contact, and fall back to plain jit dispatch if the AOT path fails
+    (version quirks, sharding mismatch) — correctness never depends on
+    the cache."""
+    key = (solver_key, _arg_signature(args))
+    with _EXECUTABLES_LOCK:
+        ex = _EXECUTABLES.get(key)
+        if ex is not None:
+            _EXECUTABLES.move_to_end(key)
+    if ex is not None:
+        try:
+            out = ex(*args)
+            _CACHE_STATS.record_exec(True)
+            return out
+        except Exception:
+            with _EXECUTABLES_LOCK:
+                _EXECUTABLES.pop(key, None)
+            _CACHE_STATS.record_exec(False, fallback=True)
+            return fn(*args)
+    t0 = time.perf_counter()
+    try:
+        ex = _lower_and_compile(fn, args)
+        out = ex(*args)
+    except Exception:
+        _CACHE_STATS.record_exec(False, fallback=True)
+        return fn(*args)
+    _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
+    with _EXECUTABLES_LOCK:
+        _EXECUTABLES[key] = ex
+        while len(_EXECUTABLES) > _EXECUTABLES_MAX:
+            _EXECUTABLES.popitem(last=False)
+    return out
 
 
 def _compiled_solver(
@@ -64,12 +161,11 @@ def _compiled_solver(
         tuple(d.id for d in mesh.devices.flat),
         chains_per_device, steps_per_round, engine, scorer,
     )
-    fn = _COMPILED.get(cache_key)
-    if fn is not None:  # LRU refresh: insertion order tracks recency
-        _COMPILED[cache_key] = _COMPILED.pop(cache_key)
-    else:
-        if len(_COMPILED) >= _COMPILED_MAX:  # evict oldest (insertion order)
-            _COMPILED.pop(next(iter(_COMPILED)))
+    with _COMPILED_LOCK:
+        fn = _COMPILED.get(cache_key)
+        if fn is not None:  # LRU refresh: insertion order tracks recency
+            _COMPILED[cache_key] = _COMPILED.pop(cache_key)
+    if fn is None:
         # shard_map introduces the mesh axis even for a single device, so
         # the solver always anneals with axis_name set here (collectives
         # over a singleton axis are free)
@@ -111,23 +207,29 @@ def _compiled_solver(
             in_specs = (P(), P(), P(AXIS), P())
             out_specs = (P(AXIS), P(AXIS), P(AXIS))
 
+        # pallas_call's ShapeDtypeStruct out_shapes carry no vma
+        # annotation, which jax>=0.9's varying-manual-axes check
+        # rejects inside shard_map (found the hard way: the r2 TPU
+        # bench run died here while every CPU test passed, because
+        # the Pallas scorer route is TPU-only). The out_specs above
+        # are explicit, so the check adds nothing we rely on.
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-                # pallas_call's ShapeDtypeStruct out_shapes carry no vma
-                # annotation, which jax>=0.9's varying-manual-axes check
-                # rejects inside shard_map (found the hard way: the r2 TPU
-                # bench run died here while every CPU test passed, because
-                # the Pallas scorer route is TPU-only). The out_specs above
-                # are explicit, so the check adds nothing we rely on.
-                check_vma=False,
             )
         )
-        _COMPILED[cache_key] = fn
-    return fn
+        with _COMPILED_LOCK:
+            # a concurrent builder of the same key may have landed
+            # first — keep the existing fn so both callers dispatch one
+            # executable (building the jit wrapper twice is cheap; the
+            # compile is deduplicated by _dispatch's key)
+            fn = _COMPILED.setdefault(cache_key, fn)
+            while len(_COMPILED) > _COMPILED_MAX:  # evict oldest
+                _COMPILED.pop(next(iter(_COMPILED)))
+    return fn, cache_key
 
 
 def init_sweep_state(
@@ -225,7 +327,7 @@ def solve_on_mesh(
     from ..solvers.tpu.arrays import geometric_temps
 
     n_dev = mesh.devices.size
-    fn = _compiled_solver(
+    fn, solver_key = _compiled_solver(
         mesh, chains_per_device, steps_per_round, engine, scorer
     )
     if temps is None:
@@ -235,9 +337,9 @@ def solve_on_mesh(
             state = init_sweep_state(
                 m, a_seed, key, mesh, chains_per_device
             )
-        return fn(m, state, temps)
+        return _dispatch(fn, solver_key, (m, state, temps))
     keys = jax.random.split(key, n_dev)
-    return fn(m, a_seed, keys, temps)
+    return _dispatch(fn, solver_key, (m, a_seed, keys, temps))
 
 
 def fetch_global(x):

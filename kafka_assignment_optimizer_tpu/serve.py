@@ -51,12 +51,27 @@ Endpoints:
 ``GET /schema``
     Machine-readable request/response shapes (JSON).
 
+``POST /warmup``
+    Pre-pay XLA compiles: ``{"shapes": [{"brokers": 256, "partitions":
+    10000, "rf": 3, "racks": 8}, ...], "engine": "sweep"}`` solves one
+    synthetic cluster per shape so every later production solve in the
+    same bucket (``solvers.tpu.bucket``) runs fully warm. Also runs at
+    startup via ``--warmup B:P[:R[:K]],...``.
+
 ``GET /healthz``
-    ``{"status": "ok", "solvers": [...], "platform": "tpu"}``
+    ``{"status": "ok", "solvers": [...], "platform": "tpu",
+    "cache": {...bucket/executable counters...}, "queue": {...}}``
 
 ``GET /metrics``
-    Prometheus text counters: requests/solves/evaluates/errors/sheds
-    and solve wall-clock totals (``kao_*``).
+    Prometheus text counters: requests/solves/evaluates/errors/sheds,
+    solve wall-clock totals, executable-cache hit/miss/compile-seconds
+    and solve-queue gauges (``kao_*``).
+
+Concurrency: solves run on a bounded request queue drained by a small
+worker pool (``--workers`` / ``--queue-depth``) — overlapping submits
+proceed concurrently on warm, shape-bucketed executables instead of
+serializing on a global lock; the queue sheds with 503 once full past
+``--lock-wait-s``.
 
 Run: ``python -m kafka_assignment_optimizer_tpu.serve --port 8787``.
 """
@@ -65,6 +80,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import queue as _queue
 import sys
 import threading
 import time
@@ -73,11 +89,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import landing
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
-
-# one solve at a time: solver backends (XLA executables, the native lib)
-# are process-wide resources; concurrent HTTP readers stay responsive,
-# solves serialize
-_SOLVE_LOCK = threading.Lock()
 
 # audits (/evaluate) hold their OWN lock (VERDICT r4 item 8): they are
 # pure host-side work (numpy + bound LPs + the native flow kernel — no
@@ -99,12 +110,189 @@ ALLOWED_OPTIONS = frozenset({
     "time_limit_s", "t_hi", "t_lo", "n_devices",
 })
 
-# saturation policy: how long a request waits for the solve lock before
+# saturation policy: how long a request waits for a queue slot before
 # the service sheds it with 503 (a single 10k-partition solve must not
 # make every later POST hang indefinitely), and the time limit injected
 # into each solve unless the client sets a smaller one
 DEFAULT_LOCK_WAIT_S = 30.0
 DEFAULT_MAX_SOLVE_S = 300.0
+DEFAULT_WORKERS = 2
+DEFAULT_QUEUE_DEPTH = 4
+# executable-accumulation hygiene: drop in-process jit caches after this
+# many completed solves (see _SolveQueue._maintenance)
+_CLEAR_CACHES_EVERY = 64
+
+
+class _QueueItem:
+    __slots__ = ("fn", "done", "result", "exc", "abandoned")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.exc: BaseException | None = None
+        self.abandoned = False
+
+
+class _SolveQueue:
+    """Bounded request queue + worker pool — the serving path that
+    replaced the serialize-everything solve lock. Overlapping submits
+    enqueue and run on ``workers`` daemon threads (warm, shape-bucketed
+    executables are process-wide, so two warm solves genuinely overlap:
+    host-side constructor races, bound LPs, and device dispatches
+    interleave instead of convoying behind one lock). Saturation policy:
+    a request that cannot get a queue slot within its wait budget is
+    shed with 503, exactly like the old lock timeout — but a queued
+    request keeps its place instead of stampeding on a lock."""
+
+    def __init__(self, workers: int = DEFAULT_WORKERS,
+                 depth: int = DEFAULT_QUEUE_DEPTH):
+        self.workers = max(1, int(workers))
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(1, int(depth)))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._started = False
+        self._active = 0
+        self._done_count = 0
+        self._draining = False  # maintenance holds new solves at the gate
+
+    def configure(self, workers: int | None = None,
+                  depth: int | None = None) -> None:
+        """Resize before the workers start (server startup); a no-op
+        once traffic has begun."""
+        with self._lock:
+            if self._started:
+                return
+            if workers is not None:
+                self.workers = max(1, int(workers))
+            if depth is not None:
+                self._q = _queue.Queue(maxsize=max(1, int(depth)))
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self.workers):
+                threading.Thread(
+                    target=self._run, daemon=True, name=f"kao-solve-{i}"
+                ).start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item.abandoned:  # waiter gave up while queued
+                continue
+            with self._cv:
+                # maintenance in progress: no new trace/compile may
+                # start until the cache clear has landed
+                while self._draining:
+                    self._cv.wait()
+                self._active += 1
+            try:
+                try:
+                    item.result = item.fn()
+                except BaseException as e:  # delivered to the waiter
+                    item.exc = e
+                item.done.set()
+            finally:
+                with self._cv:
+                    self._active -= 1
+                    self._done_count += 1
+                    n = self._done_count
+                    self._cv.notify_all()
+            if n % _CLEAR_CACHES_EVERY == 0:
+                self._maintenance()
+
+    def _maintenance(self) -> None:
+        """Long-lived-process executable bound: a stream of distinct
+        cluster shapes accumulates jitted executables without limit, and
+        past a few hundred distinct compiles jaxlib's XLA:CPU compile
+        has been observed to segfault (soak-found; see
+        tests/test_lp_fuzz.py). Shape bucketing collapses most of that
+        variety, but the periodic clear stays as the backstop.
+
+        Exclusion contract (the lock the old serialize-everything path
+        provided implicitly): ``_draining`` gates new solves at the
+        worker loop, this thread then waits (bounded) for in-flight
+        solves to finish, and only with zero active solves and no
+        daemon AOT compile in flight does the clear run — a clear can
+        never race an in-progress trace. If the pool stays busy past
+        the bound, the clear is skipped and retried at the next
+        multiple; warm same-bucket re-solves refill from the
+        persistent disk cache at ~cache-load cost."""
+        with self._cv:
+            if self._draining:
+                return
+            self._draining = True
+            deadline = time.monotonic() + 15.0
+            while self._active > 0:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    break
+            drained = self._active == 0
+        try:
+            from .solvers.tpu.engine import _PENDING_AOT
+
+            if drained and not _PENDING_AOT:
+                import jax
+
+                from .parallel.mesh import clear_exec_cache
+
+                clear_exec_cache()
+                jax.clear_caches()
+        except Exception:
+            pass
+        finally:
+            with self._cv:
+                self._draining = False
+                self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "queue_depth": self._q.qsize(),
+                "active_solves": self._active,
+                "workers": self.workers,
+                "solves_completed": self._done_count,
+            }
+
+    def submit(self, fn, wait_s: float, budget_s: float | None):
+        """Run ``fn`` on the worker pool; raises ApiError(503) when the
+        queue stays full past ``wait_s`` or the solve outlives the
+        service window."""
+        self._ensure_started()
+        item = _QueueItem(fn)
+        try:
+            self._q.put(item, timeout=max(float(wait_s), 0.0))
+        except _queue.Full:
+            _count(shed_total=1)
+            raise ApiError(
+                503,
+                f"solver busy (no capacity within {wait_s:.0f}s); "
+                "retry later",
+            ) from None
+        # budget_s None means the operator runs uncapped solves
+        # (--max-solve-s 0 with no client limit): wait to completion,
+        # exactly like the pre-queue synchronous path did
+        window = (
+            None if budget_s is None
+            else max(float(wait_s), 0.0) + float(budget_s) + 60.0
+        )
+        if not item.done.wait(window):
+            item.abandoned = True  # dropped if still queued; best effort
+            _count(shed_total=1)
+            raise ApiError(
+                503,
+                f"solve did not finish within the {window:.0f}s service "
+                "window; retry later",
+            )
+        if item.exc is not None:
+            raise item.exc
+        return item.result
+
+
+_SOLVES = _SolveQueue()
 
 # service counters (GET /metrics, Prometheus text format); guarded by
 # their own lock so readers never contend with a solve
@@ -129,6 +317,22 @@ def _count(**updates) -> None:
 def render_metrics() -> str:
     with _METRICS_LOCK:
         snap = dict(_METRICS)
+    # executable/bucket cache counters (solvers.tpu.bucket.STATS): the
+    # operational evidence that shape bucketing is absorbing compiles —
+    # kao_cache_exec_hits climbing while kao_cache_compiles_total stays
+    # flat is the steady state a warmed service should show
+    try:
+        from .solvers.tpu.bucket import STATS as _cache_stats
+
+        for k, v in _cache_stats.snapshot().items():
+            snap[f"cache_{k}"] = v
+    except Exception:
+        pass
+    try:
+        for k, v in _SOLVES.stats().items():
+            snap[f"queue_{k}"] = v
+    except Exception:
+        pass
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
@@ -150,19 +354,42 @@ def _parse_brokers(spec) -> list[int]:
             return parse_broker_list(spec)
         except ValueError as e:
             raise ApiError(400, f"bad 'brokers' range string: {e}") from e
-    if isinstance(spec, list) and all(isinstance(b, int) for b in spec):
+    if isinstance(spec, list) and all(
+        isinstance(b, int) and not isinstance(b, bool) for b in spec
+    ):
         return spec
     raise ApiError(400, "'brokers' must be a list of ints or a range string")
 
 
 def _parse_topology(spec, broker_ids: list[int]) -> Topology | None:
+    # every malformed shape must come back as a structured 400, never a
+    # raw exception bubbling into a 500 (e.g. a rack map with non-string
+    # keys or nested values used to die inside Topology.from_dict)
     if spec is None:
         return None
-    if spec == "even-odd":
-        return Topology.even_odd(broker_ids)
-    if isinstance(spec, dict):
-        return Topology.from_dict(spec)
+    try:
+        if spec == "even-odd":
+            return Topology.even_odd(broker_ids)
+        if isinstance(spec, dict):
+            return Topology.from_dict(spec)
+    except ApiError:
+        raise
+    except Exception as e:
+        raise ApiError(400, f"bad 'topology': {e}") from e
     raise ApiError(400, "'topology' must be a broker->rack object, 'even-odd', or null")
+
+
+def _validate_rf(rf) -> None:
+    if rf is None:
+        return
+    if isinstance(rf, bool) or not isinstance(rf, (int, dict)):
+        raise ApiError(400, "'rf' must be an int, a topic->int object, or null")
+    if isinstance(rf, dict) and not all(
+        isinstance(k, str)
+        and isinstance(v, int) and not isinstance(v, bool)
+        for k, v in rf.items()
+    ):
+        raise ApiError(400, "'rf' object must map topic names to ints")
 
 
 def handle_submit(
@@ -188,8 +415,7 @@ def handle_submit(
     all_ids = sorted(set(brokers) | set(current.broker_ids()))
     topology = _parse_topology(payload.get("topology"), all_ids)
     rf = payload.get("rf")
-    if rf is not None and not isinstance(rf, (int, dict)):
-        raise ApiError(400, "'rf' must be an int, a topic->int object, or null")
+    _validate_rf(rf)
     solver = payload.get("solver", "auto")
     if not isinstance(solver, str):
         raise ApiError(400, "'solver' must be a string")
@@ -224,13 +450,7 @@ def handle_submit(
             max_solve_s if limit is None else min(float(limit), max_solve_s)
         )
 
-    if not _SOLVE_LOCK.acquire(timeout=lock_wait_s):
-        _count(shed_total=1)
-        raise ApiError(
-            503,
-            f"solver busy (no capacity within {lock_wait_s:.0f}s); retry later",
-        )
-    try:
+    def _solve_job():
         t0 = time.perf_counter()
         res = optimize(
             current, brokers, topology, target_rf=rf, solver=solver,
@@ -241,32 +461,18 @@ def handle_submit(
             _METRICS["solves_total"] += 1
             _METRICS["solve_seconds_total"] += dt
             _METRICS["last_solve_seconds"] = dt
-            solves = _METRICS["solves_total"]
-        if solves % 64 == 0:
-            # long-lived-process executable bound: a stream of
-            # differently shaped clusters accumulates jitted
-            # executables without limit, and past a few hundred
-            # distinct compiles jaxlib's XLA:CPU compile has been
-            # observed to segfault (soak-found; not memory — see
-            # tests/test_lp_fuzz.py). Dropping the in-process caches
-            # periodically keeps the service in the stable regime;
-            # warm same-shape re-solves refill from the persistent
-            # disk cache at ~cache-load cost. Must run while
-            # _SOLVE_LOCK is still held: under ThreadingHTTPServer a
-            # released lock lets another request start tracing before
-            # the clear lands, and the _PENDING_AOT check would
-            # otherwise race a daemon AOT compile from a timed-out
-            # solve. The inner try swallows clear-time failures so
-            # they can never discard the finished plan.
-            try:
-                from .solvers.tpu.engine import _PENDING_AOT
+        return {
+            "assignment": res.assignment.to_dict(),
+            "report": res.report(),
+        }
 
-                if not _PENDING_AOT:
-                    import jax
-
-                    jax.clear_caches()
-            except Exception:
-                pass
+    try:
+        return _SOLVES.submit(
+            _solve_job, wait_s=lock_wait_s,
+            budget_s=options.get("time_limit_s"),
+        )
+    except ApiError:
+        raise
     except (ValueError, KeyError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
         raise ApiError(422, f"model rejected inputs: {msg}") from e
@@ -274,12 +480,6 @@ def handle_submit(
         raise ApiError(400, f"bad solver options: {e}") from e
     except RuntimeError as e:
         raise ApiError(500, f"solver failed: {e}") from e
-    finally:
-        _SOLVE_LOCK.release()
-    return {
-        "assignment": res.assignment.to_dict(),
-        "report": res.report(),
-    }
 
 
 def handle_evaluate(payload: dict, lock_wait_s: float,
@@ -307,8 +507,7 @@ def handle_evaluate(payload: dict, lock_wait_s: float,
     all_ids = sorted(set(brokers) | set(current.broker_ids()))
     topology = _parse_topology(payload.get("topology"), all_ids)
     rf = payload.get("rf")
-    if rf is not None and not isinstance(rf, (int, dict)):
-        raise ApiError(400, "'rf' must be an int, a topic->int object, or null")
+    _validate_rf(rf)
     from .api import evaluate
 
     if not _AUDIT_LOCK.acquire(timeout=lock_wait_s):
@@ -332,13 +531,192 @@ def handle_evaluate(payload: dict, lock_wait_s: float,
 def handle_healthz() -> dict:
     import jax
 
+    from .parallel import mesh
     from .solvers.base import available_solvers
+    from .solvers.tpu import bucket
 
     return {
         "status": "ok",
         "solvers": available_solvers(),
         "platform": jax.devices()[0].platform,
+        "cache": {
+            "bucketing_enabled": bucket.enabled(),
+            "part_ladder_head": bucket.ladder(10),
+            "executables_held": len(mesh._EXECUTABLES),
+            "persistent_cache_dir": jax.config.jax_compilation_cache_dir,
+            **bucket.STATS.snapshot(),
+        },
+        "queue": _SOLVES.stats(),
     }
+
+
+def _synthetic_cluster(brokers: int, partitions: int, rf: int,
+                       racks: int):
+    """A steady-state round-robin cluster of the requested shape, used
+    only to drive a warmup solve whose executables land in the bucket
+    (brokers, racks, rf-bucket, partition-bucket)."""
+    from .models.cluster import PartitionAssignment
+
+    parts = [
+        PartitionAssignment(
+            topic="warmup", partition=p,
+            replicas=[(p + i) % brokers for i in range(rf)],
+        )
+        for p in range(partitions)
+    ]
+    topo = Topology.from_dict(
+        {str(b): f"rack{b % racks}" for b in range(brokers)}
+    )
+    return Assignment(partitions=parts), list(range(brokers)), topo
+
+
+def _parse_warmup_shape(sh) -> tuple[int, int, int, int]:
+    """One warmup shape: {brokers, partitions, rf?, racks?} or a
+    [brokers, partitions, rf?, racks?] array. Returns (B, P, R, K)."""
+    if isinstance(sh, dict):
+        vals = (sh.get("brokers"), sh.get("partitions"),
+                sh.get("rf", 3), sh.get("racks", 1))
+    elif isinstance(sh, list) and 2 <= len(sh) <= 4:
+        vals = tuple(sh) + (3, 1)[len(sh) - 2:]
+    else:
+        raise ApiError(
+            400,
+            "each warmup shape must be {brokers, partitions, rf?, racks?} "
+            "or a [brokers, partitions, rf?, racks?] array",
+        )
+    if not all(isinstance(v, int) and not isinstance(v, bool) and v > 0
+               for v in vals):
+        raise ApiError(400, f"warmup shape values must be positive ints: {sh}")
+    b, p, r, k = vals
+    if r > b:
+        raise ApiError(400, f"warmup shape has rf {r} > brokers {b}")
+    if k > b:
+        raise ApiError(400, f"warmup shape has racks {k} > brokers {b}")
+    return b, p, r, k
+
+
+def handle_warmup(
+    payload: dict,
+    *,
+    lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+    max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+) -> dict:
+    """POST /warmup — pre-pay XLA compiles for a list of cluster shapes
+    before they carry traffic. Each shape is solved once on a synthetic
+    cluster with the engine pinned and the host-side constructor races
+    disabled (``precompile=True`` — a symmetric synthetic cluster would
+    otherwise certify on the host and never compile), through the same
+    queue and time budget as real traffic; afterwards every production
+    solve whose (brokers, racks, rf-bucket, partition-bucket) matches
+    runs fully warm. Returns per-shape bucket keys, wall clocks, and the
+    compile counters each warmup actually moved."""
+    if not isinstance(payload, dict):
+        raise ApiError(400, "request body must be a JSON object")
+    shapes = payload.get("shapes")
+    if not isinstance(shapes, list) or not shapes:
+        raise ApiError(400, "missing required field 'shapes' (non-empty list)")
+    if len(shapes) > 16:
+        raise ApiError(400, "at most 16 warmup shapes per request")
+    engine = payload.get("engine", "sweep")
+    if engine not in ("sweep", "chain"):
+        raise ApiError(400, "warmup 'engine' must be 'sweep' or 'chain'")
+    parsed = [_parse_warmup_shape(sh) for sh in shapes]
+
+    from .solvers.tpu import bucket
+
+    results = []
+    for b, p, r, k in parsed:
+        current, broker_list, topo = _synthetic_cluster(b, p, r, k)
+        # precompile=True disables the host-side constructor races: the
+        # symmetric synthetic cluster would otherwise certify on the
+        # host and never compile the device executables this endpoint
+        # exists to warm
+        options: dict = {"engine": engine, "seed": 0, "precompile": True}
+        if max_solve_s is not None:
+            options["time_limit_s"] = max_solve_s
+
+        def _job(current=current, broker_list=broker_list, topo=topo,
+                 options=options):
+            t0 = time.perf_counter()
+            res = optimize(current, broker_list, topo, solver="tpu",
+                           **options)
+            return time.perf_counter() - t0, res.solve.stats
+
+        before = bucket.STATS.snapshot()
+        try:
+            wall, stats = _SOLVES.submit(
+                _job, wait_s=lock_wait_s, budget_s=max_solve_s
+            )
+        except ApiError:
+            raise
+        except Exception as e:
+            raise ApiError(500, f"warmup solve failed: {e}") from e
+        after = bucket.STATS.snapshot()
+        results.append({
+            "shape": {"brokers": b, "partitions": p, "rf": r, "racks": k},
+            "bucket_parts": stats.get("bucket_parts"),
+            "bucket_rf": stats.get("bucket_rf"),
+            "engine": engine,
+            "wall_s": round(wall, 3),
+            "compiles": after["compiles_total"] - before["compiles_total"],
+            "compile_s": round(
+                after["compile_seconds_total"]
+                - before["compile_seconds_total"], 3,
+            ),
+            "already_warm": (
+                after["compiles_total"] == before["compiles_total"]
+            ),
+        })
+    return {"warmed": results, "cache": bucket.STATS.snapshot()}
+
+
+def parse_warmup_flag(spec: str) -> list[dict]:
+    """``--warmup "B:P[:R[:K]],..."`` -> /warmup shapes list."""
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if not 2 <= len(fields) <= 4:
+            raise ValueError(
+                f"bad warmup shape {part!r}; want brokers:partitions[:rf[:racks]]"
+            )
+        vals = [int(f) for f in fields]
+        shape = {"brokers": vals[0], "partitions": vals[1]}
+        if len(vals) > 2:
+            shape["rf"] = vals[2]
+        if len(vals) > 3:
+            shape["racks"] = vals[3]
+        shapes.append(shape)
+    if not shapes:
+        raise ValueError("empty --warmup spec")
+    return shapes
+
+
+def start_warmup_thread(shapes: list[dict], *, engine: str = "sweep",
+                        max_solve_s: float | None = DEFAULT_MAX_SOLVE_S):
+    """Server-start precompile: run the configured bucket list through
+    /warmup on a daemon thread so the listener is live immediately;
+    early traffic simply queues behind the warmup solves."""
+
+    def run():
+        try:
+            out = handle_warmup(
+                {"shapes": shapes, "engine": engine},
+                lock_wait_s=3600.0, max_solve_s=max_solve_s,
+            )
+            for row in out["warmed"]:
+                print(f"[kao] warmup {row['shape']} -> bucket "
+                      f"({row['bucket_parts']}, {row['bucket_rf']}) "
+                      f"in {row['wall_s']}s "
+                      f"(compiles={row['compiles']})", file=sys.stderr)
+        except Exception as e:  # warmup is best-effort, never fatal
+            print(f"[kao] warmup failed: {e}", file=sys.stderr)
+
+    t = threading.Thread(target=run, daemon=True, name="kao-warmup")
+    t.start()
+    return t
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -395,7 +773,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         route = self._route()
-        if route not in ("/submit", "/evaluate"):
+        if route not in ("/submit", "/evaluate", "/warmup"):
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
@@ -407,26 +785,31 @@ class Handler(BaseHTTPRequestHandler):
                 raise ApiError(400, f"bad Content-Length header: {e}") from e
             if n > MAX_BODY_BYTES:
                 raise ApiError(413, "request body too large")
+            if n < 0:
+                raise ApiError(400, "negative Content-Length")
             raw = self.rfile.read(n)
             try:
                 payload = json.loads(raw)
             except json.JSONDecodeError as e:
                 raise ApiError(400, f"invalid JSON: {e}") from e
+            lock_wait_s = getattr(self.server, "lock_wait_s",
+                                  DEFAULT_LOCK_WAIT_S)
+            max_solve_s = getattr(self.server, "max_solve_s",
+                                  DEFAULT_MAX_SOLVE_S)
             if route == "/evaluate":
                 self._send(200, handle_evaluate(
-                    payload,
-                    lock_wait_s=getattr(self.server, "lock_wait_s",
-                                        DEFAULT_LOCK_WAIT_S),
-                    max_solve_s=getattr(self.server, "max_solve_s",
-                                        DEFAULT_MAX_SOLVE_S),
+                    payload, lock_wait_s=lock_wait_s,
+                    max_solve_s=max_solve_s,
+                ))
+                return
+            if route == "/warmup":
+                self._send(200, handle_warmup(
+                    payload, lock_wait_s=lock_wait_s,
+                    max_solve_s=max_solve_s,
                 ))
                 return
             self._send(200, handle_submit(
-                payload,
-                lock_wait_s=getattr(self.server, "lock_wait_s",
-                                    DEFAULT_LOCK_WAIT_S),
-                max_solve_s=getattr(self.server, "max_solve_s",
-                                    DEFAULT_MAX_SOLVE_S),
+                payload, lock_wait_s=lock_wait_s, max_solve_s=max_solve_s,
             ))
         except ApiError as e:
             if e.status != 503:
@@ -465,19 +848,54 @@ def main(argv: list[str] | None = None) -> int:
                     default=DEFAULT_MAX_SOLVE_S,
                     help="time limit injected into every solve; clients "
                          "may tighten but not exceed it (0 = uncapped)")
+    ap.add_argument("--workers", type=int, default=DEFAULT_WORKERS,
+                    help="solve worker threads (overlapping requests run "
+                         "concurrently up to this many)")
+    ap.add_argument("--queue-depth", type=int,
+                    default=DEFAULT_QUEUE_DEPTH,
+                    help="bounded solve queue length; requests past it "
+                         "shed with 503 after --lock-wait-s")
+    ap.add_argument("--warmup", default=None, metavar="B:P[:R[:K]],...",
+                    help="bucket shapes to precompile at startup "
+                         "(brokers:partitions[:rf[:racks]] comma list); "
+                         "runs in the background, early traffic queues "
+                         "behind it")
+    ap.add_argument("--jit-cache", default=None, metavar="DIR",
+                    help="persistent XLA compile-cache directory "
+                         "(sets KAO_JIT_CACHE, so warmth survives "
+                         "process restarts)")
     args = ap.parse_args(argv)
     if args.lock_wait_s < 0:
         ap.error("--lock-wait-s must be >= 0")
     if args.max_solve_s < 0:
         ap.error("--max-solve-s must be >= 0 (0 = uncapped)")
+    if args.workers < 1:
+        ap.error("--workers must be >= 1")
+    if args.queue_depth < 1:
+        ap.error("--queue-depth must be >= 1")
+    warmup_shapes = None
+    if args.warmup:
+        try:
+            warmup_shapes = parse_warmup_flag(args.warmup)
+        except ValueError as e:
+            ap.error(str(e))
+    if args.jit_cache:
+        import os
+
+        os.environ["KAO_JIT_CACHE"] = args.jit_cache
     from .utils.platform import pin_platform
 
     pin_platform()
+    _SOLVES.configure(workers=args.workers, depth=args.queue_depth)
     srv = make_server(
         args.host, args.port, verbose=args.verbose,
         lock_wait_s=args.lock_wait_s,
         max_solve_s=args.max_solve_s or None,
     )
+    if warmup_shapes:
+        start_warmup_thread(
+            warmup_shapes, max_solve_s=args.max_solve_s or None
+        )
     print(f"listening on http://{args.host}:{srv.server_address[1]}", file=sys.stderr)
     try:
         srv.serve_forever()

@@ -127,8 +127,12 @@ def _anneal_step(
     is_lsw = jnp.logical_and(u_type >= P_REPLACE, u_type < P_REPLACE + P_LSWAP)
     is_xsw = jnp.logical_not(jnp.logical_or(is_rep, is_lsw))
 
+    # rfp == 0 only on bucket-padded rows (solvers.tpu.bucket): the
+    # max() keeps the modulus defined; the rfp > 0 validity guards below
+    # reject every move touching such a row, so the clamp never changes
+    # a real proposal
     s_raw = (row[2] & u32(0x3FFFFFFF)).astype(i32)
-    s_rep = s_raw % rfp
+    s_rep = s_raw % jnp.maximum(rfp, 1)
     s_lsw = 1 + s_raw % jnp.maximum(rfp - 1, 1)
     s1 = jnp.where(is_lsw, s_lsw, s_rep)
 
@@ -147,7 +151,7 @@ def _anneal_step(
     # second site for xswap
     p2 = (row[4] % u32(P)).astype(i32)
     rfp2 = m.rf[p2]
-    s2 = (row[5] & u32(0x3FFFFFFF)).astype(i32) % rfp2
+    s2 = (row[5] & u32(0x3FFFFFFF)).astype(i32) % jnp.maximum(rfp2, 1)
     row2 = st.a[p2]
     valid2 = m.slot_valid[p2]
     b2 = row2[s2]
@@ -159,11 +163,14 @@ def _anneal_step(
     # --- validity -----------------------------------------------------
     in_p1 = jnp.logical_and(row1 == b_in, valid1).any()
     in_p2 = jnp.logical_and(row2 == b_old, valid2).any()
-    valid_rep = jnp.logical_not(in_p1)
+    live = rfp > 0  # false only on bucket-padded rows, which are inert
+    valid_rep = jnp.logical_and(jnp.logical_not(in_p1), live)
     valid_lsw = rfp >= 2
     valid_xsw = jnp.logical_and(
-        jnp.logical_not(in_p1),
-        jnp.logical_and(jnp.logical_not(in_p2), p != p2),
+        jnp.logical_and(jnp.logical_not(in_p1), live),
+        jnp.logical_and(
+            jnp.logical_and(jnp.logical_not(in_p2), rfp2 > 0), p != p2
+        ),
     )
     valid = jnp.where(is_rep, valid_rep, jnp.where(is_lsw, valid_lsw, valid_xsw))
 
@@ -393,11 +400,10 @@ def make_solver_fn(
             # under shard_map the chains are device-varying (their RNG keys
             # are sharded) while seed/model are replicated; the scan carry
             # must be uniformly varying — pcast only the unvarying leaves
-            def to_varying(x):
-                if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
-                    return x
-                return lax.pcast(x, axis_name, to="varying")
+            # (identity on pre-vma jax, see sweep._make_to_varying)
+            from .sweep import _make_to_varying
 
+            to_varying = _make_to_varying(axis_name)
             state, best_k, best_a = jax.tree.map(
                 to_varying, (state, best_k, best_a)
             )

@@ -86,21 +86,76 @@ class ModelArrays:
         return self.rack_lo.shape[0] - 1
 
 
-def from_instance(inst: ProblemInstance) -> ModelArrays:
+def from_instance(
+    inst: ProblemInstance,
+    num_parts: int | None = None,
+    max_rf: int | None = None,
+) -> ModelArrays:
+    """Lower an instance to device arrays, optionally padded up to a
+    canonical bucket shape (``solvers.tpu.bucket``) so every instance in
+    a bucket shares one set of jitted executables.
+
+    Padded partition rows are INERT by the same mechanism that already
+    makes short replica lists inert: ``rf = 0`` and ``slot_valid`` all
+    false, so their slots null out to broker ``B`` in every histogram,
+    their weights are zero, their ``part_rack_hi`` is 0 with zero rack
+    counts, and both engines' proposal machinery rejects or no-ops moves
+    on them (``rf > 0`` guards). Padded slot columns (``max_rf``) are
+    plain invalid slots. The padding is all host-side numpy — one
+    ``jnp.asarray`` per field, exactly like the unpadded path, so no
+    extra tiny executables compile."""
     B, K = inst.num_brokers, inst.num_racks
     big = np.iinfo(np.int32).max // 4
     rack_lo = np.concatenate([inst.rack_lo, [0]]).astype(np.int32)
     rack_hi = np.concatenate([inst.rack_hi, [big]]).astype(np.int32)
+    P, R = inst.num_parts, inst.max_rf
+    Pp = P if num_parts is None else max(int(num_parts), P)
+    Rp = R if max_rf is None else max(int(max_rf), R)
+    a0, rf, slot_valid = inst.a0, inst.rf, inst.slot_valid
+    w_leader, w_follower = inst.w_leader, inst.w_follower
+    part_rack_hi = inst.part_rack_hi
+    if (Pp, Rp) != (P, R):
+        a0 = np.full((Pp, Rp), B, np.int32)
+        a0[:P, :R] = inst.a0
+        rf = np.zeros(Pp, np.int32)
+        rf[:P] = inst.rf
+        slot_valid = np.zeros((Pp, Rp), bool)
+        slot_valid[:P, :R] = inst.slot_valid
+        w_leader = np.zeros((Pp, B + 1), np.int32)
+        w_leader[:P] = inst.w_leader
+        w_follower = np.zeros((Pp, B + 1), np.int32)
+        w_follower[:P] = inst.w_follower
+        part_rack_hi = np.zeros(Pp, np.int32)
+        part_rack_hi[:P] = inst.part_rack_hi
     return ModelArrays(
-        a0=jnp.asarray(inst.a0, jnp.int32),
-        rf=jnp.asarray(inst.rf, jnp.int32),
-        slot_valid=jnp.asarray(inst.slot_valid),
-        w_lead=jnp.asarray(inst.w_leader, jnp.int32),
-        w_foll=jnp.asarray(inst.w_follower, jnp.int32),
+        a0=jnp.asarray(a0, jnp.int32),
+        rf=jnp.asarray(rf, jnp.int32),
+        slot_valid=jnp.asarray(slot_valid),
+        w_lead=jnp.asarray(w_leader, jnp.int32),
+        w_foll=jnp.asarray(w_follower, jnp.int32),
         rack_of=jnp.asarray(inst.rack_of_broker, jnp.int32),
         broker_band=jnp.asarray([inst.broker_lo, inst.broker_hi], jnp.int32),
         leader_band=jnp.asarray([inst.leader_lo, inst.leader_hi], jnp.int32),
         rack_lo=jnp.asarray(rack_lo),
         rack_hi=jnp.asarray(rack_hi),
-        part_rack_hi=jnp.asarray(inst.part_rack_hi, jnp.int32),
+        part_rack_hi=jnp.asarray(part_rack_hi, jnp.int32),
     )
+
+
+def pad_candidate(a: np.ndarray, m: ModelArrays) -> np.ndarray:
+    """Pad a host-side candidate ``[P, R]`` up to a (possibly bucketed)
+    model's ``[Pp, Rp]`` with the null broker, so padded rows read as
+    empty partitions everywhere (see :func:`from_instance`)."""
+    a = np.asarray(a, dtype=np.int32)
+    Pp, Rp = m.a0.shape
+    if a.shape == (Pp, Rp):
+        return a
+    out = np.full((Pp, Rp), m.num_brokers, np.int32)
+    out[: a.shape[0], : a.shape[1]] = a
+    return out
+
+
+def unpad_candidate(a, inst: ProblemInstance) -> np.ndarray:
+    """Slice a (possibly bucket-padded) candidate back to the instance's
+    real ``[P, R]`` shape — identity when no padding was applied."""
+    return np.asarray(a, dtype=np.int32)[: inst.num_parts, : inst.max_rf]

@@ -1,0 +1,190 @@
+"""Shape bucketing: canonical padded shapes + cache counters.
+
+Every jitted stage executable (sweep stepper, chain solver, polish,
+scorers) is keyed on the shapes of its arguments, and the dominant shape
+axis is the partition count: real fleets hold a stable broker/rack
+topology while topics — and with them partition counts — churn
+constantly. Without bucketing, every distinct (partitions, max-RF) pair
+pays a full XLA compile on first contact (BENCH_r05: 26-68 s cold vs
+4-9 s warm on the adversarial rows); with it, instance arrays are padded
+up a small geometric ladder of canonical partition counts so every
+instance inside a bucket reuses one set of executables.
+
+Padded rows are inert by construction (``arrays.from_instance``): rf=0,
+slot_valid false, zero weights, zero diversity caps — both engines'
+proposal machinery rejects or no-ops moves on them, so the padded solve
+explores exactly the real instance's search space and the returned plan
+is sliced back to the real shape before any host-side oracle sees it.
+
+The broker and rack axes are deliberately NOT padded: their band
+penalties are global scalars (a padded broker at count 0 would violate
+``broker_lo`` and poison feasibility), they are stable per fleet, and
+the Mosaic kernels bake them into tile layouts. The bucket key is
+therefore (brokers, racks, rf-bucket, partition-bucket) with the first
+two exact.
+
+Config:
+
+- ``KAO_BUCKETS=off``           disable bucketing (raw shapes).
+- ``KAO_BUCKETS=64,1024,16384`` override the partition ladder with an
+  explicit comma list (values are sorted; instances above the largest
+  rung fall back to their raw partition count).
+
+Counters feed ``serve.py``'s ``/metrics`` + ``/healthz`` and the bench
+JSON; they are process-wide and thread-safe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# partition ladder: geometric ("power-of-two-ish") from 32 up, rounded
+# to sublane multiples of 8 so padded tiles stay aligned. Growth is
+# graduated — 1.5x while buckets are small (padding a 90-partition
+# cluster to 112 costs microseconds), 1.25x from 1024 up (at-scale
+# sweeps pay per-partition work on padded rows, so the worst-case
+# padding overhead is capped at ~25% where it matters), and 256-aligned
+# above 4096 (the Pallas scoring kernel's partition tile is 256). ~40
+# rungs cover 32 .. >1M partitions — a long-lived service compiles a
+# handful of them for any real traffic mix.
+_LADDER_BASE = 32
+_LADDER_GROWTH_SMALL = 1.5
+_LADDER_GROWTH_BIG = 1.25
+_LADDER_BIG_AT = 1024
+_LADDER_ALIGN = 8
+_LADDER_TILE_AT = 4096
+_LADDER_TILE = 256
+
+# max-RF ladder: RF is tiny and coarse in practice; one rung per common
+# value, then multiples of 4. Padded slots are ordinary invalid slots.
+_RF_LADDER = (1, 2, 3, 4, 5, 6, 8)
+
+
+def _round_up(v: int, align: int) -> int:
+    return -(-int(v) // align) * align
+
+
+def enabled() -> bool:
+    return os.environ.get("KAO_BUCKETS", "").lower() not in (
+        "off", "0", "none", "false",
+    )
+
+
+def _custom_ladder() -> list[int] | None:
+    """Explicit partition ladder from ``KAO_BUCKETS``, or None."""
+    raw = os.environ.get("KAO_BUCKETS", "")
+    if not raw or raw.lower() in ("on", "1", "true"):
+        return None
+    try:
+        rungs = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return None  # malformed override: fall back to the default ladder
+    return rungs or None
+
+
+def _next_rung(v: int) -> int:
+    growth = (
+        _LADDER_GROWTH_SMALL if v < _LADDER_BIG_AT else _LADDER_GROWTH_BIG
+    )
+    align = _LADDER_TILE if v >= _LADDER_TILE_AT else _LADDER_ALIGN
+    return _round_up(v * growth, align)
+
+
+def part_bucket(num_parts: int) -> int:
+    """Smallest ladder rung >= num_parts (identity when bucketing is
+    disabled; instances above a custom ladder's top rung stay raw)."""
+    p = int(num_parts)
+    if not enabled():
+        return p
+    custom = _custom_ladder()
+    if custom is not None:
+        for rung in custom:
+            if rung >= p:
+                return rung
+        return p
+    v = _LADDER_BASE
+    while v < p:
+        v = _next_rung(v)
+    return v
+
+
+def rf_bucket(max_rf: int) -> int:
+    r = int(max_rf)
+    if not enabled():
+        return r
+    for rung in _RF_LADDER:
+        if rung >= r:
+            return rung
+    return _round_up(r, 4)
+
+
+def ladder(n: int = 16) -> list[int]:
+    """The first ``n`` rungs of the active partition ladder (for
+    /healthz and docs)."""
+    custom = _custom_ladder()
+    if custom is not None:
+        return custom[:n]
+    out, v = [], _LADDER_BASE
+    for _ in range(n):
+        out.append(v)
+        v = _next_rung(v)
+    return out
+
+
+def bucket_shape(inst) -> tuple[int, int]:
+    """(partition-bucket, rf-bucket) for a ProblemInstance."""
+    return part_bucket(inst.num_parts), rf_bucket(inst.max_rf)
+
+
+class CacheStats:
+    """Process-wide cache counters: bucket reuse (instance shape ->
+    bucket already seen), executable-cache hits/misses, and compile
+    wall-clock. One instance (``STATS``) is shared by the engine, the
+    mesh executable cache, the HTTP service, and the bench harness."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen_buckets: set = set()
+        self._c = {
+            "bucket_hits": 0,        # solve mapped to an already-seen bucket
+            "bucket_misses": 0,      # first solve for this bucket key
+            "padded_solves": 0,      # solves whose arrays were padded
+            "exec_hits": 0,          # executable served from the LRU
+            "exec_misses": 0,        # executable had to be built
+            "compiles_total": 0,     # XLA compiles actually performed
+            "compile_seconds_total": 0.0,
+            "exec_fallbacks": 0,     # AOT path failed; jit dispatch used
+        }
+
+    def record_bucket(self, key: tuple, padded: bool) -> bool:
+        """Record one solve's bucket key; returns True on a bucket hit."""
+        with self._lock:
+            hit = key in self._seen_buckets
+            self._seen_buckets.add(key)
+            self._c["bucket_hits" if hit else "bucket_misses"] += 1
+            if padded:
+                self._c["padded_solves"] += 1
+        return hit
+
+    def record_exec(self, hit: bool, compile_s: float = 0.0,
+                    fallback: bool = False) -> None:
+        with self._lock:
+            self._c["exec_hits" if hit else "exec_misses"] += 1
+            if not hit and not fallback:
+                self._c["compiles_total"] += 1
+                self._c["compile_seconds_total"] += float(compile_s)
+            if fallback:
+                self._c["exec_fallbacks"] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._c)
+            out["buckets_seen"] = len(self._seen_buckets)
+        out["compile_seconds_total"] = round(
+            out["compile_seconds_total"], 4
+        )
+        return out
+
+
+STATS = CacheStats()

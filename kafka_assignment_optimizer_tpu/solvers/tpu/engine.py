@@ -133,6 +133,7 @@ def solve_tpu(
     profile_dir: str | None = None,
     time_limit_s: float | None = None,
     cert_min_savings_s: float = 1.0,
+    precompile: bool = False,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
@@ -202,7 +203,14 @@ def solve_tpu(
     )
     members = inst._members()[0].size
     big = members > _instance_mod.AGG_MEMBER_THRESHOLD
-    if not multi and (_caps_bind(inst) or big or inst.agg_effective()):
+    if precompile:
+        # warmup solves (serve /warmup) exist to COMPILE the device
+        # path for a bucket shape; a host-side constructor certifying
+        # the symmetric synthetic cluster would skip the device — and
+        # the compile — entirely, so every race is disabled
+        lp_fut = None
+        lp_wait_s = 0.0
+    elif not multi and (_caps_bind(inst) or big or inst.agg_effective()):
         reseat_ok = _RESEAT_RACE and not knobs_set
         lp_fut = _BoundsTask(
             lambda: _construct_worker(inst, bounds_fut,
@@ -647,7 +655,9 @@ def _run_ladder(
                     # and repeating the reseat LP per shard per
                     # boundary would cost seconds for no new outcome
                     for j in np.argsort(-pk)[:1] if do_cert else []:
-                        cand = pa[j]
+                        # bucket-padded rows are sliced off before any
+                        # host-side oracle sees the candidate
+                        cand = arrays.unpad_candidate(pa[j], inst)
                         mc = inst.move_count(cand)
                         if not inst.is_feasible(cand):
                             continue
@@ -847,7 +857,7 @@ def _final_selection(
         if ub0 is None:
             final_cert = "bounds_unavailable"
         else:
-            cand_np = np.asarray(cand, dtype=np.int32)
+            cand_np = arrays.unpad_candidate(cand, inst)
             if inst.move_count(cand_np) > lb_exact:
                 final_cert = "moves_above_lb"
             elif not inst.is_feasible(cand_np):
@@ -866,8 +876,11 @@ def _final_selection(
                     final_cert = "weight_below_ub"
                     # the reseat is >= the raw champion (its internal
                     # rank guard): start the polish from it instead of
-                    # discarding the computed work
-                    cand = reseated
+                    # discarding the computed work (re-padded so the
+                    # polish executable keeps its bucket shape)
+                    cand = jnp.asarray(
+                        arrays.pad_candidate(reseated, m), jnp.int32
+                    )
     if certified_final is not None:
         # the caller's final proof block re-derives the certificate
         # from the (memoized) bounds — no special-casing needed
@@ -889,7 +902,7 @@ def _final_selection(
         best_a = pol(m, cand)
     except Exception:
         best_a = polish_jit(m, cand)
-    best_a = np.asarray(best_a, dtype=np.int32)
+    best_a = arrays.unpad_candidate(best_a, inst)
     budget = _budget_left(t0, time_limit_s)
     try:
         # join bounded by the remaining deadline budget: when the
@@ -1008,7 +1021,22 @@ def _solve_tpu_inner(
     else:
         a_seed = certified_a  # never dispatched: the ladder is empty
         resumed = False
-    m = arrays.from_instance(inst) if certified_a is None else None
+    # shape bucketing: lower the model padded up to its canonical bucket
+    # so every instance in the bucket reuses one set of jitted/AOT
+    # executables (solvers.tpu.bucket); padded rows are inert and every
+    # host-side oracle below sees plans sliced back to the real shape
+    if certified_a is None:
+        from . import bucket
+
+        bkt_parts, bkt_rf = bucket.bucket_shape(inst)
+        m = arrays.from_instance(inst, num_parts=bkt_parts, max_rf=bkt_rf)
+        bucket.STATS.record_bucket(
+            (inst.num_brokers, inst.num_racks, bkt_parts, bkt_rf),
+            padded=(bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf),
+        )
+    else:
+        m = None
+        bkt_parts = bkt_rf = None
     t_seed = time.perf_counter()
 
     if certified_a is None:
@@ -1044,7 +1072,8 @@ def _solve_tpu_inner(
     scorer = "pallas" if (platform == "tpu" and engine == "sweep") else "xla"
 
     seed_dev = (
-        jnp.asarray(a_seed, jnp.int32) if certified_a is None else None
+        jnp.asarray(arrays.pad_candidate(a_seed, m), jnp.int32)
+        if certified_a is None else None
     )
     # sweep engine: full population state (including the per-shard RNG
     # keys) threads through the chunks — the chunked schedule replays
@@ -1209,6 +1238,11 @@ def _solve_tpu_inner(
                 inst, "_flow_big_declines", 0
             ),
             "proved_optimal": proved_optimal,
+            # shape bucketing (solvers.tpu.bucket): the canonical padded
+            # shape this solve's executables were keyed on (absent on
+            # the constructed path, which never lowers the model)
+            **({"bucket_parts": int(bkt_parts), "bucket_rf": int(bkt_rf)}
+               if bkt_parts is not None else {}),
             "time_limit_s": time_limit_req,
             "steps_per_round": steps_per_round,
             "steps_per_round_ignored": steps_per_round_ignored,

@@ -208,6 +208,25 @@ def best_key(w: jax.Array, pen: jax.Array) -> jax.Array:
     return jnp.where(pen == 0, w, -pen - 1)
 
 
+def _make_to_varying(axis_name: str):
+    """Cast replicated leaves to device-varying inside ``shard_map`` —
+    required by jax's varying-manual-axes (vma) system. Pre-vma jax
+    (0.4.x) has neither ``jax.typeof`` nor ``lax.pcast`` and needs no
+    cast (``check_rep=False`` at the shard_map boundary), so the shim
+    degrades to identity there."""
+    typeof = getattr(jax, "typeof", None)
+    pcast = getattr(lax, "pcast", None)
+    if typeof is None or pcast is None:
+        return lambda x: x
+
+    def to_varying(x):
+        if axis_name in getattr(typeof(x), "vma", frozenset()):
+            return x
+        return pcast(x, axis_name, to="varying")
+
+    return to_varying
+
+
 class SiteProposals(NamedTuple):
     """One proposed move per (chain, partition), the unit the conflict
     thinning and apply stages consume. Two move shapes share the record:
@@ -343,7 +362,15 @@ def propose_site(m: ModelArrays, a: jax.Array, bits: jax.Array, temp,
 
     dw = jnp.where(is_lsw, dw_lsw, dw_rep)
     dpen = jnp.where(is_lsw, dpen_lsw, dpen_rep)
-    legal = jnp.where(is_lsw, rf > 1, legal_rep)
+    # rf > 0: bucket-padded rows (solvers.tpu.bucket) must never be
+    # accepted — their apply is a no-op, but an accepted prio would let
+    # them win the conflict-thinning token maps and suppress real moves
+    # (measured: a heavily padded tiny instance lost most of its move
+    # throughput). All-true on unpadded instances. Mirrored bit-for-bit
+    # in ops.propose_pallas.
+    legal = jnp.logical_and(
+        jnp.where(is_lsw, rf > 1, legal_rep), rf > 0
+    )
     delta = (SCALE_W * dw - LAMBDA * dpen).astype(jnp.float32)
 
     # ---- Metropolis accept -------------------------------------------
@@ -660,8 +687,14 @@ def propose_exchange(m: ModelArrays, a, key, temp,
     other = _partner_view(packed, d, is_lower)
     dw = dw_own + other[..., 0]
     ddiv = ddiv_own + other[..., 1]
+    # both sides must be live partitions: a bucket-padded row (rf == 0,
+    # solvers.tpu.bucket) has no slot to give — its apply would be a
+    # one-sided write that duplicates a broker into the live partner.
+    # All-true on unpadded instances, so trajectories are unchanged.
+    pair_live = jnp.logical_and(rf_own > 0, rf_other > 0)
     legal = jnp.logical_and(
-        jnp.logical_and(legal_own, other[..., 2] > 0), pair_valid
+        jnp.logical_and(legal_own, other[..., 2] > 0),
+        jnp.logical_and(pair_valid, pair_live),
     )
     delta = (SCALE_W * dw - LAMBDA * (dlcnt + ddiv)).astype(jnp.float32)
     accept = jnp.logical_and(
@@ -811,11 +844,7 @@ def make_sweep_stepper_fn(
         a, best_k, best_mv, best_a, key = state
 
         if axis_name is not None:
-            def to_varying(x):
-                if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
-                    return x
-                return lax.pcast(x, axis_name, to="varying")
-
+            to_varying = _make_to_varying(axis_name)
             key = to_varying(key)
             a, best_k, best_mv, best_a = jax.tree.map(
                 to_varying, (a, best_k, best_mv, best_a)

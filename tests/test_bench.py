@@ -79,6 +79,9 @@ def test_failure_still_prints_parsable_line():
 
 
 @pytest.mark.soak
+@pytest.mark.slow  # ~2.5 min: a full bench --smoke (8 scenario children
+# + repeat probes) in subprocesses; the driver exercises bench.py
+# directly every round, so the tier-1 gate doesn't need to re-run it
 def test_default_run_embeds_full_results_table():
     """The driver's default invocation must evidence EVERY scenario in
     the single stdout line (VERDICT r2 item 3): a compact scenarios

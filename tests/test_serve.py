@@ -4,6 +4,7 @@ golden demo through POST /submit, schema error paths, and /healthz."""
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -127,9 +128,24 @@ def test_submit_rejects_path_valued_options(server_url):
         assert "unsupported option" in body["error"]
 
 
-def test_submit_busy_returns_503():
-    """VERDICT r1 item 9: a solve in flight must shed later requests
-    with 503 after a bounded wait, not queue them forever."""
+def _saturated_queue(srv_mod):
+    """A 1-worker/depth-1 solve queue whose worker and slot are both
+    pinned by blocking jobs; returns (queue, release_event)."""
+    gate = threading.Event()
+    q = srv_mod._SolveQueue(workers=1, depth=1)
+    q.submit(lambda: True, wait_s=1.0, budget_s=1.0)  # start the worker
+    blocker = srv_mod._QueueItem(lambda: gate.wait(30))
+    q._q.put(blocker, timeout=5)  # occupies the worker
+    time.sleep(0.1)
+    filler = srv_mod._QueueItem(lambda: True)
+    q._q.put(filler, timeout=5)  # occupies the only queue slot
+    return q, gate
+
+
+def test_submit_busy_returns_503(monkeypatch):
+    """VERDICT r1 item 9, queue edition: with every worker busy and the
+    bounded queue full, a new request must shed with 503 after its wait
+    budget — and succeed again once capacity frees up."""
     from kafka_assignment_optimizer_tpu import serve as srv_mod
 
     payload = {
@@ -137,22 +153,55 @@ def test_submit_busy_returns_503():
         "brokers": "0-18",
         "solver": "milp",
     }
-    assert srv_mod._SOLVE_LOCK.acquire(timeout=5)  # simulate a long solve
+    q, gate = _saturated_queue(srv_mod)
+    monkeypatch.setattr(srv_mod, "_SOLVES", q)
     try:
         with pytest.raises(ApiError) as ei:
             handle_submit(payload, lock_wait_s=0.2)
         assert ei.value.status == 503
     finally:
-        srv_mod._SOLVE_LOCK.release()
-    # lock free again: the same request now succeeds
-    out = handle_submit(payload, lock_wait_s=0.2)
+        gate.set()
+    time.sleep(0.3)  # worker drains the blocker + filler
+    out = handle_submit(payload, lock_wait_s=5.0)
     assert out["report"]["feasible"]
 
 
-def test_evaluate_succeeds_while_solve_holds_lock():
+def test_submit_concurrent_requests_both_complete():
+    """Acceptance: overlapping submits must not serialize on a global
+    lock — two concurrent requests both complete with consistent
+    metrics counters."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "milp",
+    }
+    with srv_mod._METRICS_LOCK:
+        solves_before = srv_mod._METRICS["solves_total"]
+    results: list = [None, None]
+
+    def run(i):
+        results[i] = handle_submit(dict(payload), lock_wait_s=30.0)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "concurrent submit deadlocked"
+    for out in results:
+        assert out is not None and out["report"]["feasible"]
+        assert out["report"]["replica_moves"] == 1
+    with srv_mod._METRICS_LOCK:
+        assert srv_mod._METRICS["solves_total"] == solves_before + 2
+
+
+def test_evaluate_succeeds_while_solver_saturated(monkeypatch):
     """VERDICT r4 item 8: audits are host-only and hold their own lock,
-    so a long device solve (simulated by holding _SOLVE_LOCK) must not
-    503 an /evaluate — and a saturated auditor still sheds."""
+    so a saturated solve queue must not 503 an /evaluate — and a
+    saturated auditor still sheds."""
     from kafka_assignment_optimizer_tpu import serve as srv_mod
     from kafka_assignment_optimizer_tpu.serve import handle_evaluate
 
@@ -162,12 +211,13 @@ def test_evaluate_succeeds_while_solve_holds_lock():
         "topology": "even-odd",
         "plan": demo_assignment().to_dict(),
     }
-    assert srv_mod._SOLVE_LOCK.acquire(timeout=5)  # a long solve runs
+    q, gate = _saturated_queue(srv_mod)
+    monkeypatch.setattr(srv_mod, "_SOLVES", q)
     try:
         out = handle_evaluate(payload, lock_wait_s=0.2)
         assert out["feasible"] is False  # references removed broker 19
     finally:
-        srv_mod._SOLVE_LOCK.release()
+        gate.set()
     # the audit lock itself still saturates with 503
     assert srv_mod._AUDIT_LOCK.acquire(timeout=5)
     try:
@@ -291,6 +341,67 @@ def test_evaluate_endpoint_audits_plans(server_url):
         "brokers": "0-18",
     })
     assert status == 400
+
+
+def test_submit_malformed_topology_returns_400(server_url):
+    """Satellite fix: malformed topology/rf specs must come back as
+    structured 400 JSON, not bubble into a 500 (non-int broker keys and
+    non-iterable rack lists both used to escape the parse try)."""
+    base = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    }
+    for bad_topo in ({"not_an_int": "rackA"},
+                     {"racks": {"a": 5}},
+                     {"racks": {"a": [None]}},
+                     ["rackA", "rackB"]):
+        status, body = post(server_url, {**base, "topology": bad_topo})
+        assert status == 400, (bad_topo, body)
+        assert "error" in body
+    for bad_rf in ({"t": "three"}, {"t": True}, True):
+        status, body = post(server_url, {**base, "rf": bad_rf})
+        assert status == 400, (bad_rf, body)
+    status, body = post(server_url, {**base, "brokers": [0, True, 2]})
+    assert status == 400, body
+
+
+def test_healthz_cache_and_queue_sections(server_url):
+    with urllib.request.urlopen(server_url + "/healthz", timeout=30) as r:
+        body = json.loads(r.read())
+    cache = body["cache"]
+    assert isinstance(cache["bucketing_enabled"], bool)
+    assert cache["part_ladder_head"][0] >= 1
+    for key in ("bucket_hits", "bucket_misses", "exec_hits",
+                "exec_misses", "compiles_total", "compile_seconds_total"):
+        assert key in cache
+    q = body["queue"]
+    assert q["workers"] >= 1 and q["queue_depth"] >= 0
+
+
+def test_warmup_endpoint_precompiles_bucket(server_url):
+    """POST /warmup compiles a bucket's executables once; a second
+    warmup of the same bucket reports already_warm with zero compiles
+    (the acceptance signal: same-bucket solves never see XLA compile)."""
+    shape = {"brokers": 8, "partitions": 24, "rf": 2, "racks": 2}
+    status, out = post_to(server_url, "/warmup",
+                          {"shapes": [shape], "engine": "sweep"})
+    assert status == 200, out
+    row = out["warmed"][0]
+    assert row["bucket_parts"] >= shape["partitions"]
+    assert row["wall_s"] > 0
+    status, out2 = post_to(server_url, "/warmup",
+                           {"shapes": [shape], "engine": "sweep"})
+    assert status == 200, out2
+    row2 = out2["warmed"][0]
+    assert row2["already_warm"] is True
+    assert row2["compiles"] == 0 and row2["compile_s"] == 0
+    # malformed warmup bodies are structured 400s
+    for bad in ({}, {"shapes": []}, {"shapes": ["x"]},
+                {"shapes": [{"brokers": 2, "partitions": 4, "rf": 3}]},
+                {"shapes": [[8, 24]], "engine": "bogus"}):
+        status, body = post_to(server_url, "/warmup", bad)
+        assert status == 400, (bad, body)
 
 
 def test_landing_page_front_door(server_url):

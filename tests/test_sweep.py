@@ -167,8 +167,10 @@ def test_sweep_migration_propagates_global_best(rng):
         ba, bk, _curve = solve(m_rep, seeds_sh[0], keys_sh[0], temps)
         return ba[None], bk[None]
 
+    from kafka_assignment_optimizer_tpu.parallel.mesh import _shard_map
+
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data"), P()),
