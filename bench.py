@@ -133,7 +133,7 @@ def resolve_backend() -> tuple[dict, str, str | None]:
 
 def _run_child(
     args: argparse.Namespace, name: str, env: dict, warmrun: bool,
-    kernel: bool = False,
+    kernel: bool = False, batch_bench: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -144,6 +144,8 @@ def _run_child(
         cmd.append("--smoke")
     if warmrun:
         cmd.append("--warm")
+    if batch_bench:
+        cmd.append("--batch-bench")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -311,6 +313,102 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     }
 
 
+def run_batch_throughput(smoke: bool, seed: int) -> dict:
+    """Batched multi-instance lane throughput (the PR-2 tentpole
+    evidence): B ∈ {1, 2, 4, 8} same-bucket adversarial instances
+    through ``engine.solve_tpu_batch``, reporting solves/s per width,
+    the B=8-vs-sequential speedup, and per-lane quality parity — every
+    lane must be feasible with moves at its instance's exact certificate
+    bound (adversarial decommissions have a tight lb: the replicas
+    hosted by the removed broker). Each width warms its executable
+    first, so the timed numbers are the steady-state throughput a
+    coalescing service actually sees."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    import jax
+
+    from kafka_assignment_optimizer_tpu.models.instance import build_instance
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        solve_tpu_batch,
+    )
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    kw = dict(gen.SMOKE_KWARGS["adversarial"]) if smoke else {}
+    lanes = 8
+    insts = []
+    for i in range(lanes):
+        # distinct generator seeds: 8 DIFFERENT clusters of one bucket
+        sc = gen.adversarial(seed=7 + i, **kw)
+        insts.append(
+            build_instance(sc.current, sc.broker_list, sc.topology)
+        )
+    bounds = [int(inst.move_lower_bound_exact()) for inst in insts]
+    knobs = dict(engine="sweep")
+    if smoke:
+        knobs["rounds"] = 16  # CPU smoke: keep the 15 solves in seconds
+
+    # sequential baseline: all 8 instances one at a time through the
+    # SAME lane path at B=1 (identical code, batching the only delta)
+    solve_tpu_batch(insts[:1], seeds=seed, **knobs)  # warm B=1
+    t0 = time.perf_counter()
+    seq = []
+    for i, inst in enumerate(insts):
+        seq.extend(solve_tpu_batch([inst], seeds=seed + i, **knobs))
+    wall_seq = time.perf_counter() - t0
+    widths: dict[str, dict] = {
+        "b1": {
+            "solves_per_s": round(lanes / wall_seq, 4),
+            "wall_s": round(wall_seq, 3),
+            "feasible": sum(r.stats["feasible"] for r in seq),
+        }
+    }
+    batched = {}
+    for B in (2, 4, 8):
+        sub, sub_seeds = insts[:B], [seed + i for i in range(B)]
+        solve_tpu_batch(sub, seeds=sub_seeds, **knobs)  # warm this width
+        t0 = time.perf_counter()
+        res = solve_tpu_batch(sub, seeds=sub_seeds, **knobs)
+        wall = time.perf_counter() - t0
+        widths[f"b{B}"] = {
+            "solves_per_s": round(B / wall, 4),
+            "wall_s": round(wall, 3),
+            "feasible": sum(r.stats["feasible"] for r in res),
+        }
+        batched[B] = res
+    res8 = batched[8]
+    lanes_feasible = all(r.stats["feasible"] for r in res8)
+    moves_ok = all(
+        r.stats["moves"] <= bounds[i] for i, r in enumerate(res8)
+    )
+    # per-solve quality parity: batched lane i vs its sequential solve
+    parity = [
+        {
+            "lane": i,
+            "moves": r.stats["moves"],
+            "seq_moves": seq[i].stats["moves"],
+            "objective": r.objective,
+            "seq_objective": seq[i].objective,
+            "bound": bounds[i],
+        }
+        for i, r in enumerate(res8)
+    ]
+    speedup = round(
+        widths["b8"]["solves_per_s"] / widths["b1"]["solves_per_s"], 3
+    ) if widths["b1"]["solves_per_s"] > 0 else 0.0
+    return {
+        "platform": jax.devices()[0].platform,
+        "lanes": lanes,
+        "brokers": insts[0].num_brokers,
+        "partitions": insts[0].num_parts,
+        "widths": widths,
+        "speedup_b8_vs_seq": speedup,
+        "lanes_feasible": lanes_feasible,
+        "moves_at_bound": moves_ok,
+        "parity": parity,
+    }
+
+
 def run_kernel_bench(smoke: bool) -> dict:
     """Time the Pallas scoring kernel (compiled, interpret=False) against
     the pure-XLA scorer on a production-shaped batch. TPU-only: on CPU
@@ -321,6 +419,10 @@ def run_kernel_bench(smoke: bool) -> dict:
 
 
 def child_main(args: argparse.Namespace) -> int:
+    if args.batch_bench:
+        out = run_batch_throughput(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
     out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
     if args.kernel:
         try:
@@ -408,7 +510,7 @@ def _print_final(line: dict) -> None:
     """Emit the ONE stdout line, shedding optional detail if it would
     overflow the driver's tail capture. Never raises."""
     for drop in ((), ("search_cold_runs",), ("jumbo_cold_runs",),
-                 ("kernel",), ("bucket_reuse",),
+                 ("kernel",), ("bucket_reuse",), ("batch_throughput",),
                  ("scenarios", "rows_schema")):
         for key in drop:
             line.pop(key, None)
@@ -425,7 +527,8 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          cold_cached: float | None = None,
          jumbo_runs: list[float] | None = None,
          search_cold_runs: dict | None = None,
-         bucket_reuse: dict | None = None) -> None:
+         bucket_reuse: dict | None = None,
+         batch_throughput: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
         line = {
@@ -498,6 +601,10 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # compiles == 0 / cache_hit true is the shape-bucketing
         # acceptance evidence
         line["bucket_reuse"] = bucket_reuse
+    if batch_throughput:
+        # batched-lane throughput: solves/s at B in {1,2,4,8} same-bucket
+        # instances + B=8-vs-sequential speedup + per-lane quality flags
+        line["batch_throughput"] = batch_throughput
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)
@@ -521,6 +628,10 @@ def main() -> int:
                     help="suppress the auto-enabled kernel micro-bench")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--warm", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--batch-bench", action="store_true",
+                    help="also run the batched-lane throughput scenario "
+                         "(B in {1,2,4,8} same-bucket instances; "
+                         "auto-enabled with --all)")
     args = ap.parse_args()
 
     if args.child:
@@ -633,10 +744,28 @@ def main() -> int:
             search_cold_runs[sname] = runs
         search_cold_runs = search_cold_runs or None
 
+    batch_throughput: dict | None = None
+    if args.all or args.batch_bench:
+        # the batched-lane throughput scenario (PR-2 tentpole evidence):
+        # one child, B in {1,2,4,8} same-bucket instances; compacted to
+        # the per-width solves/s + speedup + quality flags for stdout
+        rb, eb = _run_child(args, "batch_throughput", env, warmrun=False,
+                            batch_bench=True)
+        if rb is not None:
+            print("[bench] BATCH " + json.dumps(rb), file=sys.stderr)
+            batch_throughput = {
+                **{k: v["solves_per_s"] for k, v in rb["widths"].items()},
+                "speedup_b8": rb["speedup_b8_vs_seq"],
+                "lanes_feasible": rb["lanes_feasible"],
+                "moves_at_bound": rb["moves_at_bound"],
+            }
+        else:
+            batch_throughput = {"error": (eb or "failed")[:120]}
+
     emit(head, platform, tpu_err, args.scenario, head_err,
          scenarios=rows if args.all else None, cold_cached=cold_cached,
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
-         bucket_reuse=bucket_reuse)
+         bucket_reuse=bucket_reuse, batch_throughput=batch_throughput)
     return 0
 
 

@@ -64,6 +64,7 @@ def optimize(
     topology: Topology | dict | None = None,
     target_rf: int | dict | None = None,
     solver: str = "auto",
+    instance: ProblemInstance | None = None,
     **solver_kwargs,
 ) -> OptimizeResult:
     """Compute a minimal-move, constraint-satisfying reassignment plan.
@@ -72,6 +73,10 @@ def optimize(
     assignment (JSON text, dict, or :class:`Assignment`), the target broker
     list, the broker->rack topology, and optionally a new replication
     factor (the reference's RF-change use case, ``README.md:8-10``).
+
+    ``instance`` may carry a prebuilt :class:`ProblemInstance` for these
+    same inputs (the serving path builds it early for bucket-key routing);
+    it skips the rebuild, nothing else.
     """
     t0 = time.perf_counter()
     if isinstance(current, str):
@@ -81,7 +86,10 @@ def optimize(
     if isinstance(topology, dict):
         topology = Topology.from_dict(topology)
 
-    inst = build_instance(current, broker_list, topology, target_rf)
+    inst = (
+        instance if instance is not None
+        else build_instance(current, broker_list, topology, target_rf)
+    )
     result = get_solver(solver)(inst, **solver_kwargs)
     plan = inst.decode(result.a)
     moves = move_diff(current, plan)
@@ -92,6 +100,42 @@ def optimize(
         instance=inst,
         wall_clock_s=time.perf_counter() - t0,
     )
+
+
+def optimize_batch(
+    currents: Sequence[Assignment],
+    instances: Sequence[ProblemInstance],
+    seeds: int | Sequence[int] = 0,
+    **solver_kwargs,
+) -> list[OptimizeResult]:
+    """Solve L independent prebuilt instances through ONE batched TPU
+    dispatch (``solvers.tpu.engine.solve_tpu_batch``) and decode each
+    lane back to its own reassignment plan + move diff. The serving
+    path's coalescing dispatcher is the caller: it groups same-bucket
+    requests, hands them here as one solve, and demultiplexes the
+    returned per-request results. ``currents[i]`` must be the assignment
+    ``instances[i]`` was built from (the diff is computed against it)."""
+    if len(currents) != len(instances):
+        raise ValueError(
+            f"{len(currents)} assignments for {len(instances)} instances"
+        )
+    from .solvers.tpu.engine import solve_tpu_batch
+
+    t0 = time.perf_counter()
+    results = solve_tpu_batch(list(instances), seeds=seeds,
+                              **solver_kwargs)
+    wall = time.perf_counter() - t0
+    out = []
+    for current, inst, res in zip(currents, instances, results):
+        plan = inst.decode(res.a)
+        out.append(OptimizeResult(
+            assignment=plan,
+            moves=move_diff(current, plan),
+            solve=res,
+            instance=inst,
+            wall_clock_s=wall,
+        ))
+    return out
 
 
 def evaluate(

@@ -26,6 +26,13 @@ tiled so arbitrarily many partitions stream through VMEM:
 ``ops.score.score_batch`` (pure XLA) is the correctness oracle and the
 non-TPU fallback; parity is asserted in tests/test_score_pallas.py via
 interpret mode on the CPU mesh.
+
+Batched multi-instance LANES (``sweep.make_lane_stepper_fn``) reach this
+kernel through ``jax.vmap`` over the lane axis: vmap of ``pallas_call``
+lifts the lane dimension into a leading grid axis, so an L-lane batch
+runs the identical per-lane kernel body with an L-times grid — no
+kernel changes, and interpret mode executes the same lifted form on CPU
+(lane parity pinned in tests/test_lanes.py).
 """
 
 from __future__ import annotations

@@ -114,40 +114,70 @@ def _lower_and_compile(fn, args):
     return fn.lower(*args).compile()
 
 
+# in-flight compile dedup: (solver, shapes) keys whose first caller is
+# still inside _lower_and_compile. The serve worker pool runs cold
+# same-bucket requests CONCURRENTLY, and without this gate each of them
+# would pay the full 26-68 s XLA compile of an identical executable
+# (and double-count compile_seconds_total).
+_INFLIGHT: dict[tuple, threading.Event] = {}
+
+
 def _dispatch(fn, solver_key: tuple, args: tuple):
     """Run the solver through the executable cache: reuse the compiled
     executable for this (solver, shapes) key, compile-and-cache on first
-    contact, and fall back to plain jit dispatch if the AOT path fails
-    (version quirks, sharding mismatch) — correctness never depends on
-    the cache."""
+    contact (concurrent first contacts on one key wait for the single
+    compile instead of duplicating it), and fall back to plain jit
+    dispatch if the AOT path fails (version quirks, sharding mismatch) —
+    correctness never depends on the cache."""
     key = (solver_key, _arg_signature(args))
-    with _EXECUTABLES_LOCK:
-        ex = _EXECUTABLES.get(key)
+    while True:
+        with _EXECUTABLES_LOCK:
+            ex = _EXECUTABLES.get(key)
+            if ex is not None:
+                _EXECUTABLES.move_to_end(key)
+                inflight = None
+            else:
+                inflight = _INFLIGHT.get(key)
+                if inflight is None:
+                    _INFLIGHT[key] = threading.Event()
         if ex is not None:
-            _EXECUTABLES.move_to_end(key)
-    if ex is not None:
-        try:
-            out = ex(*args)
-            _CACHE_STATS.record_exec(True)
-            return out
-        except Exception:
-            with _EXECUTABLES_LOCK:
-                _EXECUTABLES.pop(key, None)
+            try:
+                out = ex(*args)
+                _CACHE_STATS.record_exec(True)
+                return out
+            except Exception:
+                with _EXECUTABLES_LOCK:
+                    _EXECUTABLES.pop(key, None)
+                _CACHE_STATS.record_exec(False, fallback=True)
+                return fn(*args)
+        if inflight is None:
+            break  # this thread owns the compile
+        # another thread is compiling this exact key: wait for it, then
+        # re-check the cache (bounded — a wedged compile must not hang
+        # the waiter forever; on timeout fall through to jit dispatch,
+        # which serializes on jax's own compile cache anyway)
+        if not inflight.wait(timeout=600.0):
             _CACHE_STATS.record_exec(False, fallback=True)
             return fn(*args)
     t0 = time.perf_counter()
     try:
-        ex = _lower_and_compile(fn, args)
-        out = ex(*args)
-    except Exception:
-        _CACHE_STATS.record_exec(False, fallback=True)
-        return fn(*args)
-    _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
-    with _EXECUTABLES_LOCK:
-        _EXECUTABLES[key] = ex
-        while len(_EXECUTABLES) > _EXECUTABLES_MAX:
-            _EXECUTABLES.popitem(last=False)
-    return out
+        try:
+            ex = _lower_and_compile(fn, args)
+            out = ex(*args)
+        except Exception:
+            _CACHE_STATS.record_exec(False, fallback=True)
+            return fn(*args)
+        _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
+        with _EXECUTABLES_LOCK:
+            _EXECUTABLES[key] = ex
+            while len(_EXECUTABLES) > _EXECUTABLES_MAX:
+                _EXECUTABLES.popitem(last=False)
+        return out
+    finally:
+        with _EXECUTABLES_LOCK:
+            ev = _INFLIGHT.pop(key, None)
+        if ev is not None:
+            ev.set()
 
 
 def _compiled_solver(
@@ -230,6 +260,187 @@ def _compiled_solver(
             while len(_COMPILED) > _COMPILED_MAX:  # evict oldest
                 _COMPILED.pop(next(iter(_COMPILED)))
     return fn, cache_key
+
+
+def _compiled_lane_solver(
+    mesh: Mesh,
+    chains_per_device: int,
+    steps_per_round: int,
+    engine: str = "sweep",
+    scorer: str = "xla",
+):
+    """Jitted shard_map host for the BATCHED lane solvers (L independent
+    instances, one padded bucket shape, one dispatch): the same
+    chains-over-devices sharding as ``_compiled_solver``, with the lane
+    axis vmapped INSIDE each shard — so global state leaves are
+    ``[n_dev, L, ...]`` sharded on the device axis, and the per-lane
+    migration collectives ride the same mesh axis. Cached alongside the
+    single-instance solvers (the "lanes" tag keeps the keys disjoint);
+    jit's shape keying handles L, so warm same-bucket batches of a new
+    size compile once and then dispatch the cached executable."""
+    cache_key = (
+        tuple(d.id for d in mesh.devices.flat),
+        chains_per_device, steps_per_round, engine, scorer, "lanes",
+    )
+    with _COMPILED_LOCK:
+        fn = _COMPILED.get(cache_key)
+        if fn is not None:
+            _COMPILED[cache_key] = _COMPILED.pop(cache_key)
+    if fn is None:
+        if engine == "sweep":
+            from ..solvers.tpu.sweep import make_lane_stepper_fn
+
+            solve = make_lane_stepper_fn(
+                chains_per_device, axis_name=AXIS, scorer=scorer
+            )
+
+            def shard_fn(m_stack, state, temps: jax.Array):
+                state = jax.tree.map(lambda x: x[0], state)
+                state, best_a, best_k, curve = solve(m_stack, state, temps)
+                state = jax.tree.map(lambda x: x[None], state)
+                return state, best_a[None], best_k[None], curve[None]
+
+            in_specs = (P(), P(AXIS), P())
+            out_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+        else:
+            from ..solvers.tpu.anneal import make_lane_solver_fn
+
+            solve = make_lane_solver_fn(
+                chains_per_device, steps_per_round, axis_name=AXIS
+            )
+
+            def shard_fn(m_stack, seeds, keys, temps: jax.Array):
+                # seeds [L, P, R] replicated; keys [n_dev, L, 2] sharded
+                best_a, best_k, curve = solve(m_stack, seeds, keys[0],
+                                              temps)
+                return best_a[None], best_k[None], curve[None]
+
+            in_specs = (P(), P(), P(AXIS), P())
+            out_specs = (P(AXIS), P(AXIS), P(AXIS))
+
+        fn = jax.jit(
+            _shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+            )
+        )
+        with _COMPILED_LOCK:
+            fn = _COMPILED.setdefault(cache_key, fn)
+            while len(_COMPILED) > _COMPILED_MAX:
+                _COMPILED.pop(next(iter(_COMPILED)))
+    return fn, cache_key
+
+
+def init_lane_state(
+    m_stack,
+    lane_seeds: np.ndarray,
+    keys: jax.Array,
+    mesh: Mesh,
+    chains_per_device: int,
+):
+    """Initial sweep-engine state for L lanes, tiled over the mesh:
+    per-lane analogue of :func:`init_sweep_state` with every leaf
+    gaining a lane axis after the device axis — ``a [n_dev, L, N, P,
+    R]``, ranks ``[n_dev, L, N]``, per-(device, lane) RNG keys
+    ``[n_dev, L, 2]``. Lane l's slice is exactly what
+    ``init_sweep_state`` would build for that instance alone with key
+    ``keys[l]`` (the B=1 bit-parity anchor).
+
+    ``lane_seeds`` is host numpy ``[L, P, R]`` (padded to the bucket);
+    ``keys`` is ``[L, 2]`` per-lane PRNG keys."""
+    n_dev = mesh.devices.size
+    n = chains_per_device
+    lane_seeds = np.asarray(lane_seeds, np.int32)
+    L, n_parts, n_slots = lane_seeds.shape
+    k0, mv0 = _lane_seed_rank_fn()(jnp.asarray(lane_seeds), m_stack)
+    k0, mv0 = np.asarray(k0), np.asarray(mv0)  # [L]
+    tile_a = np.broadcast_to(
+        lane_seeds[None, :, None], (n_dev, L, n, n_parts, n_slots)
+    )
+    # per-(device, lane) keys: each lane splits ITS key over the device
+    # axis, exactly as the single-instance path splits its one key —
+    # [L, n_dev, 2] -> [n_dev, L, 2]
+    dev_keys = jax.vmap(lambda k: jax.random.split(k, n_dev))(keys)
+    state = (
+        tile_a,
+        np.broadcast_to(k0[None, :, None], (n_dev, L, n)).astype(k0.dtype),
+        np.broadcast_to(mv0[None, :, None], (n_dev, L, n)).astype(np.int32),
+        tile_a,
+        jnp.transpose(dev_keys, (1, 0, 2)),
+    )
+    sh = jax.sharding.NamedSharding(mesh, P(AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), state)
+
+
+_LANE_SEED_RANK = None
+
+
+def _lane_seed_rank_fn():
+    """Jitted per-lane (best_key, moves) of the L seed candidates —
+    ``(seeds [L, P, R], m_stack) -> ([L], [L])``."""
+    global _LANE_SEED_RANK
+    if _LANE_SEED_RANK is None:
+        from ..ops.score import moves_batch, score_batch
+        from ..solvers.tpu.sweep import best_key
+
+        @jax.jit
+        def f(seeds, m_stack):
+            def one(a, m):
+                s = score_batch(a[None], m)
+                return (
+                    best_key(s.weight, s.penalty)[0],
+                    moves_batch(a[None], m)[0],
+                )
+
+            return jax.vmap(one)(seeds, m_stack)
+
+        _LANE_SEED_RANK = f
+    return _LANE_SEED_RANK
+
+
+def solve_lanes(
+    m_stack,
+    mesh: Mesh,
+    chains_per_device: int,
+    temps: jax.Array,
+    state=None,
+    lane_seeds=None,
+    keys=None,
+    engine: str = "sweep",
+    steps_per_round: int = 1,
+    scorer: str = "xla",
+):
+    """Run L independent same-bucket instances through ONE batched
+    dispatch, chains sharded over ``mesh`` and lanes vmapped inside each
+    shard. Sweep engine (stateful): pass ``state`` from
+    :func:`init_lane_state` (or a previous chunk); returns ``(state',
+    best_a [n_dev, L, P, R], best_k [n_dev, L], curve [n_dev, L,
+    sweeps])``. Chain engine: pass ``lane_seeds [L, P, R]`` and ``keys
+    [L, 2]``; returns ``(best_a, best_k, curve)`` with the same leading
+    axes. Dispatches through the AOT executable cache exactly like the
+    single-instance path — a warm same-(bucket, L) batch never
+    compiles."""
+    fn, solver_key = _compiled_lane_solver(
+        mesh, chains_per_device, steps_per_round, engine, scorer
+    )
+    if engine == "sweep":
+        if state is None:
+            if lane_seeds is None or keys is None:
+                raise ValueError(
+                    "sweep lanes need state= or (lane_seeds=, keys=)"
+                )
+            state = init_lane_state(
+                m_stack, lane_seeds, keys, mesh, chains_per_device
+            )
+        return _dispatch(fn, solver_key, (m_stack, state, temps))
+    n_dev = mesh.devices.size
+    dev_keys = jnp.transpose(
+        jax.vmap(lambda k: jax.random.split(k, n_dev))(keys), (1, 0, 2)
+    )
+    seeds = jnp.asarray(np.asarray(lane_seeds, np.int32))
+    return _dispatch(fn, solver_key, (m_stack, seeds, dev_keys, temps))
 
 
 def init_sweep_state(

@@ -73,6 +73,14 @@ proceed concurrently on warm, shape-bucketed executables instead of
 serializing on a global lock; the queue sheds with 503 once full past
 ``--lock-wait-s``.
 
+Coalescing (docs/BATCHING.md): same-bucket TPU solves that arrive while
+the pool is busy are grouped for up to ``--batch-window-ms`` (or
+``--max-batch`` lanes) and dispatched as ONE batched lane solve
+(``engine.solve_tpu_batch``), then demultiplexed per request; sparse
+requests bypass the window and keep single-solve latency. ``/metrics``
+carries the batch-size histogram (``kao_batch_size_total{size=...}``),
+coalesce-wait totals, and per-lane quality counters.
+
 Run: ``python -m kafka_assignment_optimizer_tpu.serve --port 8787``.
 """
 
@@ -118,6 +126,19 @@ DEFAULT_LOCK_WAIT_S = 30.0
 DEFAULT_MAX_SOLVE_S = 300.0
 DEFAULT_WORKERS = 2
 DEFAULT_QUEUE_DEPTH = 4
+# request coalescing (--batch-window-ms / --max-batch): same-bucket TPU
+# solves that arrive while the pool is busy are grouped for up to the
+# window, then submitted as ONE batched lane solve (engine.solve_tpu_batch)
+# and demultiplexed. A request that finds free capacity bypasses the
+# window entirely — sparse traffic pays zero added latency.
+DEFAULT_BATCH_WINDOW_MS = 25.0
+DEFAULT_MAX_BATCH = 8
+# options the batched lane path understands; a request carrying any
+# other knob (e.g. steps_per_round) takes the single-solve path
+_BATCHABLE_OPTIONS = frozenset({
+    "seed", "batch", "rounds", "sweeps", "engine", "time_limit_s",
+    "t_hi", "t_lo", "n_devices",
+})
 # executable-accumulation hygiene: drop in-process jit caches after this
 # many completed solves (see _SolveQueue._maintenance)
 _CLEAR_CACHES_EVERY = 64
@@ -305,13 +326,44 @@ _METRICS = {
     "shed_total": 0,          # 503 saturation sheds
     "solve_seconds_total": 0.0,
     "last_solve_seconds": 0.0,
+    # request coalescing (the batched lane path)
+    "batch_solves_total": 0,        # batched dispatches completed
+    "batched_requests_total": 0,    # requests served THROUGH a batch
+    "batch_bypass_total": 0,        # sparse requests that skipped the window
+    "coalesce_wait_seconds_total": 0.0,  # enqueue -> flush, summed
+    "batch_lanes_feasible_total": 0,     # per-lane quality counters
+    "batch_lane_moves_total": 0,
+    "batch_lane_weight_total": 0,
 }
+# batch-size histogram: coalesced dispatch size -> count (rendered as
+# the labeled counter family kao_batch_size_total{size="N"})
+_BATCH_SIZES: dict[int, int] = {}
 
 
 def _count(**updates) -> None:
     with _METRICS_LOCK:
         for k, v in updates.items():
             _METRICS[k] += v
+
+
+def _record_batch(size: int, waited_s: float, reports: list[dict]) -> None:
+    """Metrics for one coalesced dispatch: size histogram, coalesce
+    wait, and per-lane solve quality."""
+    with _METRICS_LOCK:
+        _BATCH_SIZES[size] = _BATCH_SIZES.get(size, 0) + 1
+        _METRICS["batch_solves_total"] += 1
+        _METRICS["batched_requests_total"] += size
+        _METRICS["coalesce_wait_seconds_total"] += waited_s
+        for rep in reports:
+            _METRICS["batch_lanes_feasible_total"] += int(
+                bool(rep.get("feasible"))
+            )
+            _METRICS["batch_lane_moves_total"] += int(
+                rep.get("replica_moves") or 0
+            )
+            _METRICS["batch_lane_weight_total"] += int(
+                rep.get("objective_weight") or 0
+            )
 
 
 def render_metrics() -> str:
@@ -333,12 +385,21 @@ def render_metrics() -> str:
             snap[f"queue_{k}"] = v
     except Exception:
         pass
+    with _METRICS_LOCK:
+        sizes = dict(_BATCH_SIZES)
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
         kind = "counter" if k.endswith("_total") else "gauge"
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {v}")
+    # batched-dispatch size histogram: one labeled sample per observed
+    # size — the operational proof coalescing is (or is not) engaging
+    lines.append("# TYPE kao_batch_size_total counter")
+    for size in sorted(sizes):
+        lines.append(
+            f'kao_batch_size_total{{size="{size}"}} {sizes[size]}'
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -346,6 +407,185 @@ class ApiError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
         self.status = status
+
+
+class _BatchGroup:
+    """One pending same-bucket batch: entries + their waiters, the
+    window timer, and the flush latch."""
+
+    __slots__ = ("key", "entries", "waiters", "timer", "flushed",
+                 "created", "wait_s", "budget_s")
+
+    def __init__(self, key, wait_s, budget_s):
+        self.key = key
+        self.entries: list[dict] = []
+        self.waiters: list[_QueueItem] = []
+        self.timer = None
+        self.flushed = False
+        self.created = time.perf_counter()
+        self.wait_s = wait_s
+        self.budget_s = budget_s
+
+
+class _Coalescer:
+    """The request-coalescing dispatcher in front of the solve queue.
+
+    Same-bucket TPU solves that arrive while the worker pool is busy
+    are held for up to ``window_s`` (or until ``max_batch`` of them
+    accumulate), then submitted as ONE batched lane solve
+    (``api.optimize_batch`` -> ``engine.solve_tpu_batch``) whose
+    per-lane results are demultiplexed back to the waiting requests.
+    The group key is the exact executable identity — (brokers, racks,
+    partition-bucket, rf-bucket) plus the shared non-seed solver knobs —
+    so every batch is stackable by construction.
+
+    Latency contract: a request that finds FREE capacity (idle worker,
+    empty queue, no pending same-key group) bypasses the window and
+    runs the full single-solve path — sparse traffic pays nothing for
+    the batching machinery. Coalescing only engages where the request
+    would have queued anyway, turning queue wait into batch width."""
+
+    def __init__(self, window_s: float = DEFAULT_BATCH_WINDOW_MS / 1e3,
+                 max_batch: int = DEFAULT_MAX_BATCH):
+        self._lock = threading.Lock()
+        self._groups: dict[tuple, _BatchGroup] = {}
+        self.window_s = window_s
+        self.max_batch = max_batch
+
+    def configure(self, window_ms: float | None = None,
+                  max_batch: int | None = None) -> None:
+        with self._lock:
+            if window_ms is not None:
+                self.window_s = max(float(window_ms), 0.0) / 1e3
+            if max_batch is not None:
+                self.max_batch = max(int(max_batch), 1)
+
+    def enabled(self) -> bool:
+        return self.max_batch > 1
+
+    def should_bypass(self, key) -> bool:
+        """True when this request should skip coalescing entirely: no
+        same-key group is already pending AND the pool has free
+        capacity (the solve would start immediately, so holding it for
+        the window could only add latency)."""
+        with self._lock:
+            if key in self._groups:
+                return False
+        q = _SOLVES.stats()
+        idle = (q["active_solves"] < q["workers"]
+                and q["queue_depth"] == 0)
+        if idle:
+            _count(batch_bypass_total=1)
+        return idle
+
+    def submit(self, key, entry: dict, wait_s: float,
+               budget_s: float | None) -> dict:
+        """Join (or open) the pending group for ``key`` and wait for
+        the batched solve to deliver this request's result."""
+        waiter = _QueueItem(None)
+        flush_me = None
+        with self._lock:
+            grp = self._groups.get(key)
+            if grp is None:
+                grp = _BatchGroup(key, wait_s, budget_s)
+                self._groups[key] = grp
+                t = threading.Timer(self.window_s, self._flush,
+                                    args=(grp,))
+                t.daemon = True
+                grp.timer = t
+                t.start()
+            grp.entries.append(entry)
+            grp.waiters.append(waiter)
+            if budget_s is not None:
+                # the batch runs under the TIGHTEST member budget
+                grp.budget_s = (
+                    budget_s if grp.budget_s is None
+                    else min(grp.budget_s, budget_s)
+                )
+            if len(grp.entries) >= self.max_batch:
+                flush_me = grp
+        if flush_me is not None:
+            self._flush(flush_me)
+        window = (
+            None if budget_s is None
+            else float(wait_s) + float(budget_s) + 60.0 + self.window_s
+        )
+        if not waiter.done.wait(window):
+            waiter.abandoned = True
+            _count(shed_total=1)
+            raise ApiError(
+                503,
+                f"batched solve did not finish within the {window:.0f}s "
+                "service window; retry later",
+            )
+        if waiter.exc is not None:
+            raise waiter.exc
+        return waiter.result
+
+    def _flush(self, grp: _BatchGroup) -> None:
+        """Close the group (idempotent: the window timer and the
+        max-batch filler may race here), run its batched solve through
+        the bounded queue, and demux per-lane results to the waiters."""
+        with self._lock:
+            if grp.flushed:
+                return
+            grp.flushed = True
+            if self._groups.get(grp.key) is grp:
+                del self._groups[grp.key]
+            entries = list(grp.entries)
+            waiters = list(grp.waiters)
+        if grp.timer is not None:
+            grp.timer.cancel()
+        waited = time.perf_counter() - grp.created
+
+        def job():
+            return _run_batch_job(entries)
+
+        try:
+            outs = _SOLVES.submit(job, wait_s=grp.wait_s,
+                                  budget_s=grp.budget_s)
+        except BaseException as e:
+            for w in waiters:
+                w.exc = e
+                w.done.set()
+            return
+        _record_batch(len(outs), waited,
+                      [o["report"] for o in outs])
+        for w, out in zip(waiters, outs):
+            w.result = out
+            w.done.set()
+
+
+def _run_batch_job(entries: list[dict]) -> list[dict]:
+    """Worker-pool body of one coalesced dispatch: one batched lane
+    solve, per-request response dicts out (same shape as /submit's
+    single-solve response)."""
+    from .api import optimize_batch
+
+    t0 = time.perf_counter()
+    opts = dict(entries[0]["options"])
+    budgets = [e["options"].get("time_limit_s") for e in entries
+               if e["options"].get("time_limit_s") is not None]
+    if budgets:
+        opts["time_limit_s"] = min(budgets)
+    outs = optimize_batch(
+        [e["current"] for e in entries],
+        [e["instance"] for e in entries],
+        seeds=[e["seed"] for e in entries],
+        **{k: v for k, v in opts.items() if k != "seed"},
+    )
+    dt = time.perf_counter() - t0
+    with _METRICS_LOCK:
+        _METRICS["solves_total"] += len(outs)
+        _METRICS["solve_seconds_total"] += dt
+        _METRICS["last_solve_seconds"] = dt
+    return [
+        {"assignment": o.assignment.to_dict(), "report": o.report()}
+        for o in outs
+    ]
+
+
+_COALESCER = _Coalescer()
 
 
 def _parse_brokers(spec) -> list[int]:
@@ -450,23 +690,57 @@ def handle_submit(
             max_solve_s if limit is None else min(float(limit), max_solve_s)
         )
 
-    def _solve_job():
-        t0 = time.perf_counter()
-        res = optimize(
-            current, brokers, topology, target_rf=rf, solver=solver,
-            **options,
-        )
-        dt = time.perf_counter() - t0
-        with _METRICS_LOCK:
-            _METRICS["solves_total"] += 1
-            _METRICS["solve_seconds_total"] += dt
-            _METRICS["last_solve_seconds"] = dt
-        return {
-            "assignment": res.assignment.to_dict(),
-            "report": res.report(),
-        }
-
     try:
+        # coalescing path: explicit TPU solves whose knobs the batched
+        # lane solver understands may ride a shared dispatch. The
+        # instance is built NOW (host-side numpy, milliseconds) so the
+        # group key is the EXACT executable identity; the single-solve
+        # path below reuses it either way.
+        inst = None
+        if (
+            solver == "tpu"
+            and _COALESCER.enabled()
+            and set(options) <= _BATCHABLE_OPTIONS
+        ):
+            from .models.instance import build_instance
+            from .solvers.tpu import bucket
+
+            inst = build_instance(current, brokers, topology, rf)
+            non_seed = tuple(sorted(
+                (k, v) for k, v in options.items() if k != "seed"
+            ))
+            key = (inst.num_brokers, inst.num_racks,
+                   *bucket.bucket_shape(inst), non_seed)
+            if not _COALESCER.should_bypass(key):
+                return _COALESCER.submit(
+                    key,
+                    {
+                        "current": current,
+                        "instance": inst,
+                        "seed": options.get("seed", 0),
+                        "options": {k: v for k, v in options.items()
+                                    if k != "seed"},
+                    },
+                    wait_s=lock_wait_s,
+                    budget_s=options.get("time_limit_s"),
+                )
+
+        def _solve_job():
+            t0 = time.perf_counter()
+            res = optimize(
+                current, brokers, topology, target_rf=rf, solver=solver,
+                instance=inst, **options,
+            )
+            dt = time.perf_counter() - t0
+            with _METRICS_LOCK:
+                _METRICS["solves_total"] += 1
+                _METRICS["solve_seconds_total"] += dt
+                _METRICS["last_solve_seconds"] = dt
+            return {
+                "assignment": res.assignment.to_dict(),
+                "report": res.report(),
+            }
+
         return _SOLVES.submit(
             _solve_job, wait_s=lock_wait_s,
             budget_s=options.get("time_limit_s"),
@@ -547,6 +821,11 @@ def handle_healthz() -> dict:
             **bucket.STATS.snapshot(),
         },
         "queue": _SOLVES.stats(),
+        "coalescing": {
+            "enabled": _COALESCER.enabled(),
+            "window_ms": round(_COALESCER.window_s * 1e3, 3),
+            "max_batch": _COALESCER.max_batch,
+        },
     }
 
 
@@ -592,6 +871,19 @@ def _parse_warmup_shape(sh) -> tuple[int, int, int, int]:
         raise ApiError(400, f"warmup shape has rf {r} > brokers {b}")
     if k > b:
         raise ApiError(400, f"warmup shape has racks {k} > brokers {b}")
+    # resource caps: the synthetic cluster is built server-side on the
+    # handler thread, so a ~60-byte body must never be able to request a
+    # multi-GB allocation (brokers/partitions far past any bucket this
+    # service could ever serve). Caps sit an order of magnitude above
+    # the jumbo benchmark config.
+    if b > 65_536:
+        raise ApiError(400, f"warmup brokers {b} exceeds cap 65536")
+    if p > 1_000_000 or p * r > 4_000_000:
+        raise ApiError(
+            400,
+            f"warmup shape {p} partitions x rf {r} exceeds the "
+            "1M-partition / 4M-replica-slot cap",
+        )
     return b, p, r, k
 
 
@@ -609,7 +901,15 @@ def handle_warmup(
     queue and time budget as real traffic; afterwards every production
     solve whose (brokers, racks, rf-bucket, partition-bucket) matches
     runs fully warm. Returns per-shape bucket keys, wall clocks, and the
-    compile counters each warmup actually moved."""
+    compile counters each warmup actually moved.
+
+    Counter caveat: ``compiles``/``already_warm`` are derived from
+    process-global cache deltas, so a PRODUCTION solve running
+    concurrently with the warmup can bleed its compiles into (or absorb
+    them out of) a shape's row. Warm up before taking traffic — the
+    startup ``--warmup`` path — or treat overlapping rows as
+    approximate; per-solve counter attribution is the clean fix and is
+    deliberately out of scope here."""
     if not isinstance(payload, dict):
         raise ApiError(400, "request body must be a JSON object")
     shapes = payload.get("shapes")
@@ -855,6 +1155,16 @@ def main(argv: list[str] | None = None) -> int:
                     default=DEFAULT_QUEUE_DEPTH,
                     help="bounded solve queue length; requests past it "
                          "shed with 503 after --lock-wait-s")
+    ap.add_argument("--batch-window-ms", type=float,
+                    default=DEFAULT_BATCH_WINDOW_MS,
+                    help="request-coalescing window: same-bucket TPU "
+                         "solves arriving while the pool is busy are "
+                         "grouped for up to this long, then run as one "
+                         "batched lane solve (sparse requests bypass "
+                         "the window entirely)")
+    ap.add_argument("--max-batch", type=int, default=DEFAULT_MAX_BATCH,
+                    help="max lanes per coalesced solve "
+                         "(1 disables coalescing)")
     ap.add_argument("--warmup", default=None, metavar="B:P[:R[:K]],...",
                     help="bucket shapes to precompile at startup "
                          "(brokers:partitions[:rf[:racks]] comma list); "
@@ -873,6 +1183,10 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--workers must be >= 1")
     if args.queue_depth < 1:
         ap.error("--queue-depth must be >= 1")
+    if args.batch_window_ms < 0:
+        ap.error("--batch-window-ms must be >= 0")
+    if args.max_batch < 1:
+        ap.error("--max-batch must be >= 1")
     warmup_shapes = None
     if args.warmup:
         try:
@@ -887,6 +1201,8 @@ def main(argv: list[str] | None = None) -> int:
 
     pin_platform()
     _SOLVES.configure(workers=args.workers, depth=args.queue_depth)
+    _COALESCER.configure(window_ms=args.batch_window_ms,
+                         max_batch=args.max_batch)
     srv = make_server(
         args.host, args.port, verbose=args.verbose,
         lock_wait_s=args.lock_wait_s,

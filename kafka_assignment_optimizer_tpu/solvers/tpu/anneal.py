@@ -371,6 +371,23 @@ def make_round_runner(steps_per_round: int, axis_name: str | None):
     return run_round
 
 
+def make_lane_solver_fn(
+    n_chains: int,
+    steps_per_round: int,
+    axis_name: str | None = None,
+):
+    """Batched multi-instance form of :func:`make_solver_fn`: L
+    independent lanes (stacked models + seeds + keys, one padded bucket
+    shape) anneal in ONE dispatch — ``(m_stack [L, ...], seeds
+    [L, P, R], keys [L, 2], temps [rounds]) -> (best_a [L, P, R],
+    best_k [L], curve [L, rounds])``. Plain ``jax.vmap`` over the lane
+    axis: per-lane trajectories are bit-identical to solving each lane
+    alone with the same key (the migration collectives vmap per lane —
+    a lane's chains only ever migrate within that lane)."""
+    solve = make_solver_fn(n_chains, steps_per_round, axis_name)
+    return jax.vmap(solve, in_axes=(0, 0, 0, None))
+
+
 def make_solver_fn(
     n_chains: int,
     steps_per_round: int,

@@ -46,8 +46,16 @@ def geometric_temps(t_hi: float, t_lo: float, n: int) -> jax.Array:
     eager jnp op here would compile its own tiny executable, and over a
     tunneled TPU every one of those costs a ~0.5 s round-trip to the
     remote compiler — measured r5, the eager setup ops were ~6 s of a
-    ~30 s cold solve."""
-    ladder = t_hi * (t_lo / t_hi) ** (np.arange(n) / max(n - 1, 1))
+    ~30 s cold solve.
+
+    Computed END TO END in float32: the ladder the device consumes is
+    float32, so building it in float64 and rounding at the edge made
+    the exact temps depend on the host's float64 `**` — a checkpoint
+    resumed under a different numpy could replay a different trajectory.
+    """
+    f = np.float32
+    expo = np.arange(n, dtype=np.float32) / f(max(n - 1, 1))
+    ladder = f(t_hi) * (f(t_lo) / f(t_hi)) ** expo
     return jnp.asarray(ladder.astype(np.float32))
 
 
@@ -140,6 +148,27 @@ def from_instance(
         rack_hi=jnp.asarray(rack_hi),
         part_rack_hi=jnp.asarray(part_rack_hi, jnp.int32),
     )
+
+
+def stack_models(models: list[ModelArrays]) -> ModelArrays:
+    """Stack L same-shape models along a new leading LANE axis — the
+    batched multi-instance form the lane solvers consume (one padded
+    bucket shape, L independent instances). Every field gains a leading
+    ``[L]`` axis; the result is only meaningful under ``jax.vmap``
+    (its shape-derived properties would read the lane axis), so callers
+    treat it as an opaque pytree. Raises ValueError on shape skew —
+    lanes must already share a bucket (same padded P/R and exact B/K)."""
+    if not models:
+        raise ValueError("stack_models needs at least one model")
+    first = [x.shape for x in jax.tree_util.tree_leaves(models[0])]
+    for m in models[1:]:
+        got = [x.shape for x in jax.tree_util.tree_leaves(m)]
+        if got != first:
+            raise ValueError(
+                "lane models disagree on shape; pad every instance to a "
+                f"common bucket first (expected {first}, got {got})"
+            )
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *models)
 
 
 def pad_candidate(a: np.ndarray, m: ModelArrays) -> np.ndarray:

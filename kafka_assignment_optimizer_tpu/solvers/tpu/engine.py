@@ -148,6 +148,11 @@ def solve_tpu(
     # instance flag had here.)
     inst._bounds_cancelled = False
     inst._construct_path = None
+    # per-solve telemetry: the exact-flow decline counter accumulates
+    # inside bound computations, so a repeat solve against the same
+    # instance would otherwise report the PREVIOUS solve's declines
+    # (advisor r5: stale stats["flow_bound_declines"])
+    inst._flow_big_declines = 0
     enable_compile_cache()
     # backend init costs ~5 s over a tunneled TPU and the host-side
     # workers below (bounds prefetch, plan constructor) don't need the
@@ -375,7 +380,10 @@ def _construct_worker(inst: ProblemInstance, bounds_fut,
     may compute the class grouping concurrently with the bounds
     worker — a benign duplicated memo fill, off the main thread), it
     joins the main bounds prefetch first so the two workers never
-    duplicate the multi-second bound LPs."""
+    duplicate the multi-second bound LPs.
+
+    Like every constructor worker, returns the uniform 3-tuple
+    ``(plan, certified, extends_greedy)``."""
     # past the unaggregated-LP size the constructor's only viable path
     # is the aggregated formulation; when THAT will refuse
     # (agg_construct_viable False — e.g. a shuffled 50k-partition
@@ -394,7 +402,7 @@ def _construct_worker(inst: ProblemInstance, bounds_fut,
             # instances (the adv50k class) usually fall to the greedy
             # + exact-reseat racer — certified with no compile
             return _reseat_worker(inst, bounds_fut)
-        return None, False
+        return None, False, False
     try:
         bounds_fut.result()
     except Exception:
@@ -403,8 +411,8 @@ def _construct_worker(inst: ProblemInstance, bounds_fut,
 
     plan = construct(inst)
     if plan is None:
-        return None, False
-    return plan, inst.certify_optimal(plan)
+        return None, False, False
+    return plan, inst.certify_optimal(plan), False
 
 
 def _exact_worker(inst: ProblemInstance, bounds_fut) -> tuple:
@@ -417,7 +425,10 @@ def _exact_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     certify's move bound is memoized there; two threads must not race
     the same computations). Time-limited: losing the race must not
     leave an unkillable HiGHS solve grinding host CPU into the next
-    request (the failure class ADVICE r2's cancel closed for bounds)."""
+    request (the failure class ADVICE r2's cancel closed for bounds).
+
+    Returns the uniform constructor 3-tuple ``(plan, certified,
+    extends_greedy)``."""
     try:
         bounds_fut.result()
     except Exception:
@@ -426,16 +437,16 @@ def _exact_worker(inst: ProblemInstance, bounds_fut) -> tuple:
 
     r = solve_milp(inst, time_limit_s=2 * _CONSTRUCT_WAIT_S)
     if not r.optimal or r.a is None:
-        return None, False
+        return None, False, False
     plan = np.asarray(r.a, dtype=np.int32)
     if r.objective is not None:
         inst._agg_weight_ub = int(r.objective)
     if inst.certify_optimal(plan):
         inst._construct_path = "milp"
-        return plan, True
+        return plan, True, False
     # weight-optimal but not provably move-minimal: still a strong
     # warm start for the annealer
-    return plan, False
+    return plan, False, False
 
 
 class _BoundsTask:
@@ -494,17 +505,18 @@ def _await_constructor(lp_fut, lp_wait_s, checkpoint, t0, time_limit_s):
     budget = _budget_left(t0, time_limit_s)
     # per-worker adaptive wait, chosen by solve_tpu when it picked the
     # racer (45 s past the aggregation threshold, a 15 s middle tier
-    # for the mid-size reseat racer, 5 s otherwise). Tolerant unpack:
-    # the reseat racer returns a third extends-greedy element; the
-    # other workers (and test doubles) return plain (plan, ok)
+    # for the mid-size reseat racer, 5 s otherwise). Every constructor
+    # worker returns the uniform 3-tuple (plan, ok, extends_greedy), so
+    # the unpack is strict — a wrong-arity worker is a bug, and the
+    # except below turns it into "no constructed plan", never a crash.
     lp_warm_extends = False
     try:
-        plan, ok, *rest = lp_fut.result(
+        plan, ok, lp_warm_extends = lp_fut.result(
             timeout=(
                 lp_wait_s if budget is None else min(lp_wait_s, budget)
             )
         )
-        lp_warm_extends = bool(rest and rest[0])
+        lp_warm_extends = bool(lp_warm_extends)
     except Exception:
         plan, ok = None, False
     if ok:
@@ -624,7 +636,7 @@ def _run_ladder(
                 # of the ladder with its certified plan
                 if lp_fut is not None and lp_fut.done():
                     try:
-                        plan, ok, *_rest = lp_fut.result()
+                        plan, ok, _extends = lp_fut.result()
                     except Exception:
                         plan, ok = None, False
                     if ok:
@@ -927,7 +939,7 @@ def _final_selection(
         # bounds join above may have consumed the last of it
         budget = _budget_left(t0, time_limit_s)
         try:
-            plan, _ok, *_rest = lp_fut.result(
+            plan, _ok, _extends = lp_fut.result(
                 timeout=10.0 if budget is None else budget
             )
         except Exception:
@@ -1279,6 +1291,261 @@ def _solve_tpu_inner(
             ),
         },
     )
+
+
+def solve_tpu_batch(
+    insts: list,
+    seeds: int | list[int] = 0,
+    *,
+    engine: str | None = None,
+    batch: int | None = None,
+    rounds: int | None = None,
+    sweeps: int | None = None,
+    t_hi: float | None = None,
+    t_lo: float | None = None,
+    n_devices: int | None = None,
+    time_limit_s: float | None = None,
+    certify: bool = False,
+) -> list[SolveResult]:
+    """Solve L independent instances in ONE batched device dispatch —
+    the multi-tenant throughput path (serve's coalescing dispatcher and
+    the bench throughput scenario). Every instance is padded up to one
+    COMMON bucket shape (the max of the lanes' bucket rungs) and lowered
+    into a lane-stacked model; the vmapped lane solver then anneals all
+    L lanes concurrently, chains sharded over the mesh, so the sweep's
+    VPU work scales with L at near-constant dispatch depth — the
+    measured ~15% HBM / ~4% compute roofline headroom (BENCH_r05) is
+    exactly what the extra lanes soak up.
+
+    Deliberately simpler than :func:`solve_tpu`: no host-side
+    constructor races, no chunk-boundary certificates, no polish — the
+    batch path exists for warm same-bucket throughput, where those
+    host-side stages would serialize L times on the critical path.
+    Per-lane results ARE exactly verified against the numpy oracle, and
+    ``certify=True`` additionally runs the per-lane optimality
+    certificate (bound LPs: seconds per lane at scale — bench evidence,
+    not a serving default).
+
+    ``seeds`` is one int (lane i gets ``seed + i``) or a per-lane list.
+    Instances whose broker/rack axes differ cannot stack (those axes
+    are never padded — see ``solvers.tpu.bucket``); such calls fall
+    back to sequential :func:`solve_tpu` solves, tagged
+    ``stats["lane_fallback"]``.
+
+    ``time_limit_s`` is enforced the same way the single path enforces
+    it: the ladder is cut into chunks (``_build_chunks`` — multiples of
+    the snapshot cadence, so a chunked sweep run is bit-identical to
+    the uncut ladder) and the wall clock is checked between chunks; a
+    batch out of budget stops early with ``stats["timed_out"]`` and
+    returns the per-lane bests found so far (never worse than each
+    lane's seed)."""
+    t0 = time.perf_counter()
+    if not insts:
+        return []
+    if isinstance(seeds, int):
+        seeds = [seeds + i for i in range(len(insts))]
+    if len(seeds) != len(insts):
+        raise ValueError(
+            f"got {len(seeds)} seeds for {len(insts)} instances"
+        )
+    L = len(insts)
+    axes = {(i.num_brokers, i.num_racks) for i in insts}
+    if len(axes) > 1:
+        out = []
+        for inst, s in zip(insts, seeds):
+            r = solve_tpu(inst, seed=s, engine=engine, batch=batch,
+                          rounds=rounds, sweeps=sweeps, t_hi=t_hi,
+                          t_lo=t_lo, n_devices=n_devices,
+                          time_limit_s=time_limit_s)
+            r.stats["lane_fallback"] = "brokers/racks differ across lanes"
+            out.append(r)
+        return out
+
+    from ...parallel.mesh import fetch_global, make_mesh, solve_lanes
+    from ...utils.platform import enable_compile_cache, ensure_backend
+    from . import bucket
+
+    for inst in insts:
+        inst._bounds_cancelled = False
+        inst._construct_path = None
+        inst._flow_big_declines = 0
+    enable_compile_cache()
+    platform = ensure_backend()
+    # search-effort defaults follow the LARGEST lane (same bucket ⇒ same
+    # executable cost); the engine must resolve before the budget knobs
+    # mean anything (see _defaults)
+    biggest = max(insts, key=lambda i: i.num_parts)
+    d = _defaults(biggest, platform, engine)
+    engine = d["engine"]
+    batch = batch or d["batch"]
+    rounds = rounds or sweeps or d["rounds"]
+    steps_per_round = d["steps_per_round"]
+    if t_hi is None:
+        t_hi = 2.0 if engine == "sweep" else 2.5
+    if t_lo is None:
+        t_lo = 0.02 if engine == "sweep" else 0.05
+
+    # one COMMON bucket for the whole batch: the max rung over lanes, so
+    # every lane's arrays share one padded shape (the stacking invariant)
+    bkt_parts = max(bucket.part_bucket(i.num_parts) for i in insts)
+    bkt_rf = max(bucket.rf_bucket(i.max_rf) for i in insts)
+    B, K = insts[0].num_brokers, insts[0].num_racks
+    models = []
+    lane_seeds = np.empty((L, bkt_parts, bkt_rf), np.int32)
+    for i, inst in enumerate(insts):
+        bucket.STATS.record_bucket(
+            (B, K, bkt_parts, bkt_rf),
+            padded=(bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf),
+        )
+        m = arrays.from_instance(inst, num_parts=bkt_parts, max_rf=bkt_rf)
+        models.append(m)
+        a_seed = np.asarray(greedy_seed(inst), dtype=np.int32)
+        assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
+            "seed left unfilled slots"
+        )
+        lane_seeds[i] = arrays.pad_candidate(a_seed, m)
+    m_stack = arrays.stack_models(models)
+    seed_moves = [int(inst.move_count(arrays.unpad_candidate(
+        lane_seeds[i], inst))) for i, inst in enumerate(insts)]
+
+    mesh = make_mesh(n_devices)
+    n_dev = mesh.devices.size
+    chains_per_device = max(1, batch // n_dev)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    scorer = "pallas" if (platform == "tpu" and engine == "sweep") else "xla"
+
+    # chunked ladder + between-chunk clock checks — the same deadline
+    # mechanism the single path runs (sweep chunks thread the full lane
+    # state, so a chunked schedule is bit-identical to the uncut one;
+    # the chain engine reseeds each lane from its best-so-far at the
+    # boundary, exactly like the single path's reseed)
+    deadline = None if time_limit_s is None else t0 + time_limit_s
+    chunks = _build_chunks(biggest, engine, rounds, t_hi, t_lo,
+                           time_limit_s)
+    state = None
+    cur_seeds, cur_keys = lane_seeds, keys
+    curves: list = []
+    rounds_run = 0
+    timed_out = False
+    pop_a = pop_k = None
+    pallas_fallback = None
+    warm_chunk_s: float | None = None
+
+    def run_chunk(scorer_now, chunk_temps, state):
+        out = solve_lanes(
+            m_stack, mesh, chains_per_device, chunk_temps, state=state,
+            lane_seeds=cur_seeds, keys=cur_keys, engine=engine,
+            steps_per_round=steps_per_round, scorer=scorer_now,
+        )
+        if engine == "sweep":
+            new_state, pa, pk, cv = out
+        else:
+            new_state, (pa, pk, cv) = None, out
+        jax.block_until_ready(pa)
+        return new_state, pa, pk, cv
+
+    for ci, chunk_temps in enumerate(chunks):
+        if deadline is not None and ci > 1 and warm_chunk_s is not None:
+            # chunk 0 is compile-inclusive; only warm chunk times gate
+            if deadline - time.perf_counter() < warm_chunk_s * 0.9:
+                timed_out = True
+                break
+        tc = time.perf_counter()
+        try:
+            state, pop_a, pop_k, cv = run_chunk(scorer, chunk_temps,
+                                                state)
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            is_lowering = scorer == "pallas" and any(
+                s in msg for s in ("Mosaic", "mosaic", "pallas",
+                                   "Pallas", "lowering", "Lowering")
+            )
+            if not is_lowering:
+                raise
+            pallas_fallback = repr(e)[:500]
+            scorer = "xla"
+            state, pop_a, pop_k, cv = run_chunk(scorer, chunk_temps,
+                                                state)
+        chunk_s = time.perf_counter() - tc
+        if ci > 0:
+            warm_chunk_s = (
+                chunk_s if warm_chunk_s is None
+                else min(warm_chunk_s, chunk_s)
+            )
+        rounds_run += int(chunk_temps.shape[0])
+        curves.append(cv)
+        over = deadline is not None and time.perf_counter() > deadline
+        if engine != "sweep" and ci + 1 < len(chunks) and not over:
+            # chain boundary reseed: each lane continues from its best
+            # shard winner with a fresh per-lane key stream
+            pa_np = np.asarray(fetch_global(pop_a))
+            pk_np = np.asarray(fetch_global(pop_k))
+            top = pk_np.argmax(axis=0)  # [L]
+            cur_seeds = np.stack(
+                [pa_np[top[i], i] for i in range(L)]
+            ).astype(np.int32)
+            cur_keys = jax.vmap(jax.random.split)(cur_keys)[:, 1]
+        if over:
+            timed_out = ci + 1 < len(chunks)
+            break
+    t_solve = time.perf_counter()
+
+    # per-lane final selection on the host: rank each lane's per-shard
+    # winners under the solve's lexicographic objective via the exact
+    # numpy oracle (n_dev candidates per lane, a few hundred KB total)
+    pa = np.asarray(fetch_global(pop_a))  # [n_dev, L, P, R]
+    curve_np = np.concatenate(
+        [np.asarray(fetch_global(c)) for c in curves], axis=2
+    )  # [n_dev, L, rounds_run]
+    wall = time.perf_counter() - t0
+    results = []
+    for i, inst in enumerate(insts):
+        best_a = None
+        best_rank = None
+        for dev in range(n_dev):
+            cand = arrays.unpad_candidate(pa[dev, i], inst)
+            pen = sum(inst.violations(cand).values())
+            r = (pen == 0, -pen, inst.preservation_weight(cand),
+                 -inst.move_count(cand))
+            if best_rank is None or r > best_rank:
+                best_rank, best_a = r, cand
+        viol = inst.violations(best_a)
+        weight = inst.preservation_weight(best_a)
+        feasible = all(v == 0 for v in viol.values())
+        proved = bool(certify and feasible and inst.certify_optimal(best_a))
+        results.append(SolveResult(
+            a=best_a,
+            solver="tpu",
+            wall_clock_s=wall,
+            objective=int(weight),
+            optimal=proved,
+            stats={
+                "platform": platform,
+                "engine": engine,
+                "lanes": L,
+                "lane": i,
+                "devices": n_dev,
+                "chains_per_device": chains_per_device,
+                "rounds": rounds,
+                "rounds_run": rounds_run,
+                "timed_out": timed_out,
+                "bucket_parts": int(bkt_parts),
+                "bucket_rf": int(bkt_rf),
+                "scorer": scorer,
+                **({"pallas_fallback": pallas_fallback}
+                   if pallas_fallback else {}),
+                "proved_optimal": proved,
+                "time_limit_s": time_limit_s,
+                "seed_moves": seed_moves[i],
+                "moves": int(inst.move_count(best_a)),
+                "feasible": feasible,
+                "violations": sum(viol.values()),
+                "anneal_s": round(t_solve - t0, 4),
+                "batch_wall_s": round(wall, 4),
+                "score_curve": _downsample(curve_np[:, i].max(axis=0), 32),
+            },
+        ))
+    return results
 
 
 def _downsample(x: np.ndarray, n: int) -> list[int]:

@@ -818,6 +818,34 @@ def make_sweep_solver_fn(
     return solve
 
 
+def make_lane_stepper_fn(
+    n_chains: int,
+    snapshot_every: int = 8,
+    axis_name: str | None = None,
+    scorer: str = "xla",
+):
+    """Batched multi-instance form of :func:`make_sweep_stepper_fn`: L
+    independent lanes (one model each, same padded bucket shape) anneal
+    concurrently in ONE dispatch. Signature: ``(m_stack [L, ...], state
+    [L, ...leaves], temps [sweeps]) -> (state', best_a [L, P, R],
+    best_k [L], curve [L, sweeps])``.
+
+    Implementation is literally ``jax.vmap`` of the single-instance
+    stepper over the lane axis — every proposal, accept, thinning and
+    migration decision is the element-wise computation the unbatched
+    stepper runs, so a lane's trajectory is bit-identical to solving it
+    alone with the same state and key (pinned in tests/test_lanes.py;
+    the temperature ladder and snapshot cadence are lane-invariant, so
+    the scan structure — including the ``lax.cond`` snapshot branches —
+    stays unbatched under the vmap). The Pallas scorer rides the same
+    wrap: ``jax.vmap`` of ``pallas_call`` lifts the lane axis into a
+    leading grid dimension, and interpret mode executes the identical
+    path on CPU (parity-pinned in CI)."""
+    solve = make_sweep_stepper_fn(n_chains, snapshot_every, axis_name,
+                                  scorer)
+    return jax.vmap(solve, in_axes=(0, 0, None))
+
+
 def make_sweep_stepper_fn(
     n_chains: int,
     snapshot_every: int = 8,

@@ -182,7 +182,7 @@ def test_engine_early_stops_with_proof(monkeypatch):
     from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
 
     monkeypatch.setattr(
-        eng, "_construct_worker", lambda *a, **k: (None, False)
+        eng, "_construct_worker", lambda *a, **k: (None, False, False)
     )
     sc, inst = _inst("decommission")
     inst.move_lower_bound_exact()

@@ -1,0 +1,155 @@
+"""Batched multi-instance solve lanes (the PR-2 tentpole).
+
+Pins the contract the coalescing dispatcher and the bench throughput
+scenario rely on: a batched lane solve is ``jax.vmap`` of the
+single-instance solver, so lane trajectories are BIT-IDENTICAL to
+solving each instance alone with the same key — batching changes
+throughput, never results. Covers both engines (sweep stateful, chain
+stateless), the Pallas interpret-mode scorer under the lane vmap, the
+engine-level ``solve_tpu_batch`` quality contract, and the unstackable
+fallback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu_batch
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _adv_instance(seed: int, **overrides):
+    kw = dict(n_brokers=32, n_topics_low=3, n_topics_high=3,
+              parts_per_topic=10, seed=seed)
+    kw.update(overrides)
+    sc = gen.adversarial(**kw)
+    return build_instance(sc.current, sc.broker_list, sc.topology)
+
+
+def test_stack_models_requires_common_shape():
+    a = arrays.from_instance(_adv_instance(7))
+    b = arrays.from_instance(_adv_instance(8), num_parts=256)
+    with pytest.raises(ValueError, match="common bucket"):
+        arrays.stack_models([a, b])
+    stacked = arrays.stack_models([a, a])
+    assert stacked.a0.shape == (2, *a.a0.shape)
+
+
+def test_sweep_lane_b1_bit_parity():
+    """A B=1 lane solve through the vmapped stepper is bit-identical to
+    the unbatched sweep solve from the same state and key."""
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    seed = np.asarray(greedy_seed(inst), np.int32)
+    mesh = pm.make_mesh()
+    key = jax.random.PRNGKey(0)
+    temps = arrays.geometric_temps(2.0, 0.02, 16)
+
+    state = pm.init_sweep_state(m, jnp.asarray(seed), key, mesh, 2)
+    _st, ba1, bk1, cv1 = pm.solve_on_mesh(
+        m, None, None, mesh, 2, 16, 1, engine="sweep", temps=temps,
+        state=state,
+    )
+    out = pm.solve_lanes(
+        arrays.stack_models([m]), mesh, 2, temps,
+        lane_seeds=seed[None], keys=jnp.stack([key]), engine="sweep",
+    )
+    _st2, ba2, bk2, cv2 = out
+    assert np.array_equal(np.asarray(ba1), np.asarray(ba2)[:, 0])
+    assert np.array_equal(np.asarray(bk1), np.asarray(bk2)[:, 0])
+    assert np.array_equal(np.asarray(cv1), np.asarray(cv2)[:, 0])
+
+
+def test_chain_lane_b1_bit_parity():
+    """Same parity contract for the chain engine's stateless lane path."""
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    seed = np.asarray(greedy_seed(inst), np.int32)
+    mesh = pm.make_mesh()
+    key = jax.random.PRNGKey(3)
+    temps = arrays.geometric_temps(2.5, 0.05, 4)
+
+    ba1, bk1, cv1 = pm.solve_on_mesh(
+        m, jnp.asarray(seed), key, mesh, 2, 4, 50, engine="chain",
+        temps=temps,
+    )
+    ba2, bk2, cv2 = pm.solve_lanes(
+        arrays.stack_models([m]), mesh, 2, temps,
+        lane_seeds=seed[None], keys=jnp.stack([key]), engine="chain",
+        steps_per_round=50,
+    )
+    assert np.array_equal(np.asarray(ba1), np.asarray(ba2)[:, 0])
+    assert np.array_equal(np.asarray(bk1), np.asarray(bk2)[:, 0])
+    assert np.array_equal(np.asarray(cv1), np.asarray(cv2)[:, 0])
+
+
+def test_lane_vmap_interpret_scorer_parity():
+    """The Pallas kernels under the lane vmap (interpret mode on CPU —
+    the very code path the TPU runs) match the XLA scorer bit-for-bit."""
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    seed = np.asarray(greedy_seed(inst), np.int32)
+    mesh = pm.make_mesh()
+    temps = arrays.geometric_temps(2.0, 0.02, 8)
+    ms = arrays.stack_models([m, m])
+    keys = jnp.stack([jax.random.PRNGKey(0), jax.random.PRNGKey(1)])
+    lane_seeds = np.stack([seed, seed])
+    o_x = pm.solve_lanes(ms, mesh, 2, temps, lane_seeds=lane_seeds,
+                         keys=keys, engine="sweep", scorer="xla")
+    o_p = pm.solve_lanes(ms, mesh, 2, temps, lane_seeds=lane_seeds,
+                         keys=keys, engine="sweep",
+                         scorer="pallas-interpret")
+    assert np.array_equal(np.asarray(o_x[1]), np.asarray(o_p[1]))
+    assert np.array_equal(np.asarray(o_x[2]), np.asarray(o_p[2]))
+
+
+def test_solve_tpu_batch_matches_b1_lane_solves():
+    """Engine-level contract: every lane of a B=3 batch returns exactly
+    the plan its own B=1 lane solve returns (same bucket, same seeds)
+    and every lane is feasible. (Closing to the exact move bound needs
+    the full annealing budget — that is the bench throughput scenario's
+    acceptance check, not this 16-round smoke's.)"""
+    insts = [_adv_instance(s) for s in (7, 8, 9)]
+    batched = solve_tpu_batch(insts, seeds=0, engine="sweep", batch=8,
+                              rounds=16)
+    for i, (inst, res) in enumerate(zip(insts, batched)):
+        assert res.stats["lanes"] == 3 and res.stats["lane"] == i
+        assert res.stats["feasible"], res.stats
+        solo = solve_tpu_batch([inst], seeds=i, engine="sweep", batch=8,
+                               rounds=16)[0]
+        assert np.array_equal(res.a, solo.a), (
+            f"lane {i} diverged from its B=1 solve"
+        )
+
+
+def test_solve_tpu_batch_unstackable_falls_back():
+    """Lanes whose broker/rack axes differ cannot stack; the batch API
+    still returns correct per-instance solves, tagged as fallbacks."""
+    a = _adv_instance(7)
+    b = _adv_instance(7, n_brokers=48, n_topics_low=4, n_topics_high=4)
+    out = solve_tpu_batch([a, b], seeds=0, rounds=8, batch=8)
+    assert len(out) == 2
+    for res in out:
+        assert res.stats.get("lane_fallback")
+        assert res.stats["feasible"]
+
+
+def test_solve_tpu_batch_mixed_sizes_share_bucket():
+    """Different partition counts inside one batch pad up to ONE common
+    bucket; every lane stays feasible and its plan decodes to its own
+    instance's shape."""
+    a = _adv_instance(7)
+    sc = gen.adversarial(n_brokers=32, n_topics_low=3, n_topics_high=3,
+                         parts_per_topic=9, seed=11)
+    b = build_instance(sc.current, sc.broker_list, sc.topology)
+    out = solve_tpu_batch([a, b], seeds=0, engine="sweep", batch=8,
+                          rounds=16)
+    assert out[0].stats["bucket_parts"] == out[1].stats["bucket_parts"]
+    assert out[0].a.shape == (a.num_parts, a.max_rf)
+    assert out[1].a.shape == (b.num_parts, b.max_rf)
+    for inst, res in zip((a, b), out):
+        assert res.stats["feasible"]

@@ -433,3 +433,133 @@ def test_landing_content_negotiation_and_schema(server_url):
     ex = schema["example"]
     status, out = post(server_url, dict(ex, solver="milp"))
     assert status == 200 and out["report"]["replica_moves"] == 1
+
+
+# --------------------------------------------------------------------------
+# request coalescing (PR-2: batched multi-instance solve lanes in serve)
+# --------------------------------------------------------------------------
+
+
+def _tpu_payload(topic_prefix=""):
+    d = demo_assignment().to_dict()
+    if topic_prefix:
+        for p in d["partitions"]:
+            p["topic"] = topic_prefix + p["topic"]
+    return {
+        "assignment": d,
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "tpu",
+        "options": {"rounds": 2, "batch": 4},
+    }
+
+
+def test_submit_coalesces_concurrent_same_bucket(monkeypatch):
+    """Acceptance: concurrent same-bucket TPU requests are grouped into
+    ONE batched lane solve (batch-size histogram shows >1) and each
+    request gets ITS OWN plan back (demux correlation pinned via
+    distinct topic names)."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    # force the coalescing branch (the pool is idle in tests) and keep
+    # the window short; restore via monkeypatch teardown
+    monkeypatch.setattr(srv_mod._Coalescer, "should_bypass",
+                        lambda self, key: False)
+    monkeypatch.setattr(srv_mod._COALESCER, "window_s", 0.25)
+    monkeypatch.setattr(srv_mod._COALESCER, "max_batch", 4)
+
+    with srv_mod._METRICS_LOCK:
+        before = dict(srv_mod._METRICS)
+        sizes_before = dict(srv_mod._BATCH_SIZES)
+    prefixes = ["", "zz.", "yy."]
+    results: list = [None] * len(prefixes)
+
+    def run(i):
+        payload = _tpu_payload(prefixes[i])
+        payload["options"] = dict(payload["options"], seed=i)
+        results[i] = handle_submit(payload, lock_wait_s=30.0)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prefixes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "coalesced submit deadlocked"
+    for i, out in enumerate(results):
+        assert out is not None and out["report"]["feasible"], out
+        topics = {p["topic"] for p in out["assignment"]["partitions"]}
+        assert all(t.startswith(prefixes[i]) for t in topics), (
+            "demux returned another request's plan"
+        )
+        if prefixes[i]:
+            assert any(t.startswith(prefixes[i]) for t in topics)
+    with srv_mod._METRICS_LOCK:
+        after = dict(srv_mod._METRICS)
+        sizes_after = dict(srv_mod._BATCH_SIZES)
+    assert after["batch_solves_total"] == before["batch_solves_total"] + 1
+    assert (after["batched_requests_total"]
+            == before["batched_requests_total"] + 3)
+    assert after["batch_lanes_feasible_total"] >= (
+        before["batch_lanes_feasible_total"] + 3
+    )
+    assert sizes_after.get(3, 0) == sizes_before.get(3, 0) + 1
+    # the histogram renders as a labeled counter family in /metrics
+    text = srv_mod.render_metrics()
+    assert 'kao_batch_size_total{size="3"}' in text
+
+
+def test_submit_sparse_request_bypasses_window():
+    """Acceptance: a single request finding free capacity skips the
+    coalescing window entirely — it runs the full single-solve path
+    (no batch dispatch recorded) and bumps the bypass counter."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    with srv_mod._METRICS_LOCK:
+        before = dict(srv_mod._METRICS)
+    out = handle_submit(_tpu_payload(), lock_wait_s=30.0)
+    assert out["report"]["feasible"]
+    with srv_mod._METRICS_LOCK:
+        after = dict(srv_mod._METRICS)
+    assert after["batch_bypass_total"] == before["batch_bypass_total"] + 1
+    assert after["batch_solves_total"] == before["batch_solves_total"]
+    assert after["solves_total"] == before["solves_total"] + 1
+
+
+def test_submit_max_batch_flushes_without_window(monkeypatch):
+    """A group hitting --max-batch dispatches immediately instead of
+    waiting out the window (the window only bounds the wait, it is not
+    a fixed tax)."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    monkeypatch.setattr(srv_mod._Coalescer, "should_bypass",
+                        lambda self, key: False)
+    monkeypatch.setattr(srv_mod._COALESCER, "window_s", 30.0)
+    monkeypatch.setattr(srv_mod._COALESCER, "max_batch", 2)
+    results: list = [None, None]
+
+    def run(i):
+        payload = _tpu_payload()
+        payload["options"] = dict(payload["options"], seed=i)
+        results[i] = handle_submit(payload, lock_wait_s=30.0)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert time.perf_counter() - t0 < 25.0, (
+        "max-batch flush waited out the 30s window"
+    )
+    for out in results:
+        assert out is not None and out["report"]["feasible"]
+
+
+def test_healthz_reports_coalescing_config(server_url):
+    with urllib.request.urlopen(server_url + "/healthz", timeout=30) as r:
+        body = json.loads(r.read())
+    co = body["coalescing"]
+    assert set(co) == {"enabled", "window_ms", "max_batch"}
+    assert co["max_batch"] >= 1

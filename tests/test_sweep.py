@@ -122,7 +122,7 @@ def test_auto_engine_selection_by_size(rng, monkeypatch):
     from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
 
     monkeypatch.setattr(
-        eng, "_construct_worker", lambda *a, **k: (None, False)
+        eng, "_construct_worker", lambda *a, **k: (None, False, False)
     )
     current, brokers, topo = random_cluster(rng, 8, 10, 2, 2, drop=0)
     res = optimize(current, brokers, topo, solver="tpu",
