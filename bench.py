@@ -50,6 +50,11 @@ import time
 
 BASELINE_BUDGET_S = 5.0  # north-star (BASELINE.json)
 
+# solve pipeline phases, in execution order — the positional layout of
+# the per-scenario phase_s column (from the engine's solve reports)
+PHASE_ORDER = ("bounds", "constructor", "seed", "ladder", "polish",
+               "verify")
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -203,9 +208,22 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     runs = 3 if warm else 1
     for _ in range(runs):
         t0 = time.perf_counter()
-        res = optimize(solver="tpu", seed=seed, **knobs, **sc.kwargs)
+        # trace=True: span-level solve reports at negligible cost (a few
+        # dozen perf_counter spans per solve) — the per-phase seconds
+        # below localize any regression in the BENCH trajectory to a
+        # pipeline phase (docs/OBSERVABILITY.md)
+        res = optimize(solver="tpu", seed=seed, trace=True, **knobs,
+                       **sc.kwargs)
         walls.append(time.perf_counter() - t0)
     cache1 = bucket.STATS.snapshot()
+    # per-phase seconds of the LAST run (the best-warm representative):
+    # bounds/constructor/seed/ladder/polish/verify from the solve report
+    trace_rep = res.solve.stats.get("solve_report") or {}
+    phase_s = {
+        k: round(v, 4)
+        for k, v in (trace_rep.get("phases") or {}).items()
+        if k in PHASE_ORDER
+    }
 
     # same-bucket reuse probe (warm search rows only): a DIFFERENT
     # cluster — a few partitions dropped, same bucket — must reuse the
@@ -285,6 +303,10 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         },
         "bucket_parts": report.get("solver_bucket_parts"),
         "bucket_rf": report.get("solver_bucket_rf"),
+        # per-phase wall seconds of the representative run (solve-trace
+        # telemetry): localizes a wall-clock regression to bounds /
+        # constructor / seed / ladder / polish / verify
+        "phase_s": phase_s,
         **({"bucket_reuse": bucket_reuse} if bucket_reuse else {}),
         "moves": report["replica_moves"],
         "min_moves_lb": sc.min_moves_lb,
@@ -448,7 +470,8 @@ STDOUT_BUDGET = 1600
 # the child's runs — warm runs at compiles=0 is the bucketing win.
 ROW_SCHEMA = ("scenario,warm_s,cold_s,moves,min_moves_lb,feasible,"
               "proved_optimal,constructed,engine,path,compile_s,"
-              "cache_compiles,cache_hits")
+              "cache_compiles,cache_hits,"
+              "phase_s[bounds,constructor,seed,ladder,polish,verify]")
 
 
 def _compact_row(r: dict | None, name: str, err: str | None) -> list:
@@ -456,8 +479,9 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
     every README results-table row from the artifact alone."""
     if r is None:
         return [name, None, None, None, None, 0, 0, 0, "error",
-                (err or "failed")[:80], None, None, None]
+                (err or "failed")[:80], None, None, None, None]
     cache = r.get("cache") or {}
+    ph = r.get("phase_s") or {}
     return [
         r["scenario"],
         r["wall_clock_s"],
@@ -472,6 +496,8 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
         r.get("compile_s"),
         cache.get("compiles"),
         cache.get("exec_hits"),
+        # positional phase seconds (PHASE_ORDER); null = phase untimed
+        [ph.get(p) for p in PHASE_ORDER] if ph else None,
     ]
 
 

@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace of the solve loop here (tpu solver)",
     )
     ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="record a span-level solve trace (tpu solver; see "
+        "docs/OBSERVABILITY.md): the solve report — phase spans + "
+        "annealing trajectory — is attached to the stderr report as "
+        "'solve_report' (implies --report)",
+    )
+    ap.add_argument(
         "--emit-lp",
         metavar="PATH",
         help="also write the lp_solve LP-format equation file (README.md:144-185)",
@@ -197,6 +205,8 @@ def _run(args: argparse.Namespace) -> int:
         kw["checkpoint"] = args.checkpoint
     if args.profile_dir:
         kw["profile_dir"] = args.profile_dir
+    if args.trace:
+        kw["trace"] = True
     if args.time_limit:
         kw["time_limit_s"] = args.time_limit
 
@@ -220,7 +230,9 @@ def _run(args: argparse.Namespace) -> int:
     else:
         print(out)
     rep = res.report()
-    if args.report:
+    if args.trace and "solve_report" in res.solve.stats:
+        rep["solve_report"] = res.solve.stats["solve_report"]
+    if args.report or args.trace:
         print(json.dumps(rep, indent=2, default=str), file=sys.stderr)
     return 0 if rep["feasible"] else 3
 

@@ -82,7 +82,12 @@ def request_schema() -> dict:
             "GET /healthz": "service status, available solvers, "
                             "platform, executable-cache + queue state",
             "GET /metrics": "Prometheus text counters (kao_*, incl. "
-                            "kao_cache_* and kao_queue_*)",
+                            "kao_cache_*, kao_queue_* and the "
+                            "kao_phase_seconds phase histograms)",
+            "GET /debug/solves": "recent solve-trace IDs; "
+                                 "/debug/solves/<trace_id> returns that "
+                                 "solve's span-tree report "
+                                 "(docs/OBSERVABILITY.md)",
             "GET /schema": "this document",
         },
         "example": {

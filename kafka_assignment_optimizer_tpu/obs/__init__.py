@@ -1,0 +1,15 @@
+"""Observability subsystem: solve-trace spans + structured logging.
+
+- ``obs.trace`` — dependency-free nested-span tracer. Every traced solve
+  produces a structured *solve report* (span tree + annealing trajectory
+  summary) registered in a process-wide ring buffer keyed by trace ID
+  (``GET /debug/solves/<trace_id>`` in serve). Disabled is the default
+  and costs one contextvar read per instrumentation site.
+- ``obs.log`` — single-line ``key=value`` structured logger; includes
+  the active trace ID automatically.
+
+See ``docs/OBSERVABILITY.md`` for the trace-ID flow, the solve-report
+schema, and the metric naming conventions.
+"""
+
+from . import log, trace  # noqa: F401

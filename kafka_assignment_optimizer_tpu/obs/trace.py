@@ -1,0 +1,409 @@
+"""Solve-trace telemetry: nested timed spans with attributes.
+
+The engine runs a multi-stage pipeline (bounds → constructor race →
+seed → chunked anneal ladder → polish → oracle verify) across a batched
+serving path; this module is the instrument that says *which phase ate
+the budget* and *what the annealer actually did*. Design constraints:
+
+- **Dependency-free**: stdlib only (``contextvars``/``threading``/
+  ``time``) — importable from the lowest layers (``parallel.mesh``)
+  without cycles.
+- **Negligible overhead when disabled** (the default): every
+  instrumentation site (``span``/``mark``/``set_attrs``) starts with one
+  contextvar read; with no active trace, ``span`` returns a shared
+  ``nullcontext`` — no allocation, no timestamps, and keyword attrs at
+  the call sites are kept cheap (expensive attrs are computed only under
+  ``if sp is not None``).
+- **Thread-safe**: child-span attachment takes the trace lock;
+  ``wrap()`` carries a span onto worker threads (contextvars do not
+  cross threads by themselves). Attribute writes stay on the owning
+  thread.
+
+Propagation is ambient: ``begin()`` activates a trace on the current
+context, and every ``span()`` underneath — engine phases, mesh
+dispatch/compile, device transfers — attaches to it automatically, so
+the serving path can trace a whole request without threading a handle
+through every signature. ``finish()`` closes the trace, builds the
+solve report (span tree + per-phase seconds + optional annealing
+trajectory), registers it in the ``RECENT`` ring buffer (the
+``/debug/solves`` surface), and feeds the per-phase latency histograms
+rendered as ``kao_phase_seconds{phase=...}`` on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "kao_current_span", default=None
+)
+# the shared disabled-path context manager: span() must not allocate
+# when tracing is off (it sits on per-chunk and per-dispatch hot paths)
+_NULL = contextlib.nullcontext()
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _jsonable(v):
+    """Coerce an attr value to something json.dumps handles (numpy
+    scalars carry .item(); anything else falls back to str)."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    item = getattr(v, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(v)
+
+
+class Span:
+    """One timed pipeline step: name, start/end, attrs, children."""
+
+    __slots__ = ("name", "trace", "start", "end", "attrs", "children")
+
+    def __init__(self, name: str, trace: "Trace", attrs: dict | None = None):
+        self.name = name
+        self.trace = trace
+        self.start = time.perf_counter()
+        self.end: float | None = None
+        self.attrs = dict(attrs) if attrs else {}
+        self.children: list[Span] = []
+
+    def set(self, **attrs) -> None:
+        # under the trace lock: a wrap()-ed worker span can still be
+        # mutating while another thread serializes the report (a solve
+        # legitimately returns past a straggling bounds worker)
+        with self.trace._lock:
+            self.attrs.update(attrs)
+
+    @property
+    def wall_s(self) -> float | None:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self, t0: float) -> dict:
+        # snapshot under the trace lock, serialize outside it: in-flight
+        # worker spans may mutate attrs/children concurrently
+        with self.trace._lock:
+            attrs = dict(self.attrs)
+            children = list(self.children)
+            end = self.end
+        d: dict = {
+            "name": self.name,
+            "start_s": round(self.start - t0, 6),
+            # None = still running when the report was built (e.g. a
+            # straggling bounds worker past the solve's return)
+            "wall_s": (
+                None if end is None else round(end - self.start, 6)
+            ),
+        }
+        if attrs:
+            d["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+        if children:
+            d["spans"] = [c.to_dict(t0) for c in children]
+        return d
+
+
+class Trace:
+    """One solve's span tree. Created via :func:`begin`; the root span
+    is activated on the current context so nested :func:`span` calls
+    attach automatically."""
+
+    def __init__(self, trace_id: str | None = None, name: str = "solve",
+                 **attrs):
+        self.trace_id = trace_id or new_trace_id()
+        self.name = name
+        self.started_unix = time.time()
+        self._lock = threading.Lock()
+        self.root = Span(name, self, attrs)
+        self.trajectory: dict | None = None
+        self._token = None
+
+    def attach(self, parent: Span, child: Span) -> None:
+        with self._lock:
+            parent.children.append(child)
+
+    def report(self) -> dict:
+        """The solve report: span tree + per-phase seconds (first
+        occurrence of each direct child of the root) + trajectory."""
+        t0 = self.root.start
+        phases: dict[str, float] = {}
+        with self._lock:
+            children = list(self.root.children)
+        for c in children:
+            if c.end is not None and c.name not in phases:
+                phases[c.name] = round(c.end - c.start, 6)
+        rep = {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "started_unix": round(self.started_unix, 3),
+            "wall_s": (
+                None if self.root.end is None
+                else round(self.root.end - self.root.start, 6)
+            ),
+            "phases": phases,
+            "spans": self.root.to_dict(t0),
+        }
+        if self.trajectory:
+            rep["annealing"] = self.trajectory
+        return rep
+
+
+class _SpanCtx:
+    """Context manager for one child span of ``parent``."""
+
+    __slots__ = ("_parent", "_name", "_attrs", "_span", "_token")
+
+    def __init__(self, parent: Span, name: str, attrs: dict):
+        self._parent = parent
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        tr = self._parent.trace
+        sp = Span(self._name, tr, self._attrs)
+        tr.attach(self._parent, sp)
+        self._span = sp
+        self._token = _CURRENT.set(sp)
+        return sp
+
+    def __exit__(self, exc_type, exc, tb):
+        sp = self._span
+        sp.end = time.perf_counter()
+        if exc is not None:
+            sp.attrs.setdefault("error", repr(exc)[:200])
+        _CURRENT.reset(self._token)
+        return False
+
+
+def span(name: str, **attrs):
+    """``with span("chunk", index=i) as sp:`` — a nested timed span, or
+    a shared no-op context (yielding None) when no trace is active.
+    Keyword attrs are evaluated at the call site even when disabled, so
+    keep them cheap there; compute expensive attrs under
+    ``if sp is not None: sp.set(...)``."""
+    parent = _CURRENT.get()
+    if parent is None:
+        return _NULL
+    return _SpanCtx(parent, name, attrs)
+
+
+def mark(name: str, **attrs) -> None:
+    """Zero-duration span: records a pipeline phase that did not run
+    (``skipped=True``) or a point event, keeping the span tree's phase
+    vocabulary complete on every path."""
+    parent = _CURRENT.get()
+    if parent is None:
+        return
+    sp = Span(name, parent.trace, attrs)
+    sp.end = sp.start
+    parent.trace.attach(parent, sp)
+
+
+def set_attrs(**attrs) -> None:
+    """Merge attrs into the current span (no-op when untraced)."""
+    sp = _CURRENT.get()
+    if sp is not None:
+        sp.set(**attrs)
+
+
+def current_span() -> Span | None:
+    return _CURRENT.get()
+
+
+def active() -> bool:
+    return _CURRENT.get() is not None
+
+
+def current_trace_id() -> str | None:
+    sp = _CURRENT.get()
+    return None if sp is None else sp.trace.trace_id
+
+
+def wrap(name: str, fn, **attrs):
+    """Bind ``fn`` to a child span of the CURRENT span so it can run on
+    another thread (contextvars do not cross threads). Returns ``fn``
+    unchanged when no trace is active — zero overhead on the default
+    path. The span stays open until the wrapped call returns; a report
+    built before then shows it with ``wall_s: null`` (in flight)."""
+    parent = _CURRENT.get()
+    if parent is None:
+        return fn
+    tr = parent.trace
+
+    def run():
+        sp = Span(name, tr, attrs)
+        tr.attach(parent, sp)
+        tok = _CURRENT.set(sp)
+        try:
+            return fn()
+        except BaseException as e:
+            # via the lock (Span.set): the solve may be serializing the
+            # report on its own thread at this very moment
+            if "error" not in sp.attrs:
+                sp.set(error=repr(e)[:200])
+            raise
+        finally:
+            sp.end = time.perf_counter()
+            _CURRENT.reset(tok)
+
+    return run
+
+
+def set_trajectory(**summary) -> None:
+    """Merge annealing-trajectory summary fields into the active trace
+    (rendered as the solve report's ``annealing`` block)."""
+    sp = _CURRENT.get()
+    if sp is not None:
+        tr = sp.trace
+        tr.trajectory = {**(tr.trajectory or {}), **summary}
+
+
+def begin(trace=None, *, name: str = "solve", **attrs) -> Trace | None:
+    """Start a trace when ``trace`` is truthy (``True`` → generated ID,
+    a string → that ID) and activate it on the current context. Returns
+    None — tracing disabled — otherwise. Nesting is legal: the token
+    restores the outer context at :func:`finish`."""
+    if not trace:
+        return None
+    tid = trace if isinstance(trace, str) else None
+    tr = Trace(trace_id=tid, name=name, **attrs)
+    tr._token = _CURRENT.set(tr.root)
+    return tr
+
+
+def finish(tr: Trace | None) -> dict | None:
+    """Close ``tr``: deactivate it, build the solve report, register it
+    in the ring buffer, and feed the per-phase latency histograms.
+    Idempotent-ish on None for uniform call sites."""
+    if tr is None:
+        return None
+    tr.root.end = time.perf_counter()
+    if tr._token is not None:
+        try:
+            _CURRENT.reset(tr._token)
+        except ValueError:
+            # finished on a different thread/context than begin(): just
+            # detach rather than corrupt the finishing thread's context
+            pass
+        tr._token = None
+    rep = tr.report()
+    RECENT.put(rep)
+    _observe_tree(tr.root)
+    return rep
+
+
+# --------------------------------------------------------------------------
+# per-phase latency histograms (rendered on /metrics as
+# kao_phase_seconds{phase=...} — Prometheus histogram convention)
+# --------------------------------------------------------------------------
+
+PHASE_BUCKETS = (0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
+
+_PHASE_LOCK = threading.Lock()
+# phase -> [per-bucket counts..., count, sum]
+_PHASES: dict[str, list] = {}
+
+
+def observe_phase(phase: str, seconds: float) -> None:
+    s = float(seconds)
+    with _PHASE_LOCK:
+        row = _PHASES.get(phase)
+        if row is None:
+            row = _PHASES[phase] = [0] * len(PHASE_BUCKETS) + [0, 0.0]
+        for i, le in enumerate(PHASE_BUCKETS):
+            if s <= le:
+                row[i] += 1
+        row[-2] += 1
+        row[-1] += s
+
+
+def phase_snapshot() -> dict[str, dict]:
+    """{phase: {"buckets": [(le_str, cumulative_count), ...],
+    "count": n, "sum": seconds}} — buckets are cumulative per the
+    Prometheus histogram convention (the +Inf bucket is ``count``)."""
+    with _PHASE_LOCK:
+        rows = {k: list(v) for k, v in _PHASES.items()}
+    out = {}
+    for phase, row in rows.items():
+        out[phase] = {
+            "buckets": [
+                (repr(le), row[i]) for i, le in enumerate(PHASE_BUCKETS)
+            ],
+            "count": row[-2],
+            "sum": round(row[-1], 6),
+        }
+    return out
+
+
+def reset_phase_stats() -> None:
+    with _PHASE_LOCK:
+        _PHASES.clear()
+
+
+def _observe_tree(root: Span) -> None:
+    """Feed every finished, non-skipped span into the phase histograms
+    (span names are a small fixed vocabulary: the pipeline phases plus
+    chunk/dispatch/compile/device_transfer)."""
+    lock = root.trace._lock
+    stack = [root]
+    while stack:
+        sp = stack.pop()
+        with lock:  # in-flight workers may still attach children
+            stack.extend(sp.children)
+            skipped = sp.attrs.get("skipped")
+        if sp is root or sp.end is None or skipped:
+            continue
+        observe_phase(sp.name, sp.end - sp.start)
+
+
+# --------------------------------------------------------------------------
+# solve-report ring buffer (GET /debug/solves/<trace_id>)
+# --------------------------------------------------------------------------
+
+
+class ReportRing:
+    """Bounded most-recent-solve-reports map, keyed by trace ID."""
+
+    def __init__(self, capacity: int = 128):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._d: OrderedDict[str, dict] = OrderedDict()
+
+    def put(self, report: dict) -> None:
+        tid = report.get("trace_id")
+        if not tid:
+            return
+        with self._lock:
+            self._d.pop(tid, None)
+            self._d[tid] = report
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def get(self, trace_id: str) -> dict | None:
+        with self._lock:
+            return self._d.get(trace_id)
+
+    def ids(self) -> list[str]:
+        """Most recent first."""
+        with self._lock:
+            return list(reversed(self._d))
+
+
+def _ring_capacity() -> int:
+    try:
+        return int(os.environ.get("KAO_TRACE_RING", "") or 128)
+    except ValueError:
+        return 128
+
+
+RECENT = ReportRing(_ring_capacity())

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs import trace as _otrace
 from ..solvers.tpu.arrays import ModelArrays
 from ..solvers.tpu.bucket import STATS as _CACHE_STATS
 
@@ -142,14 +143,16 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                     _INFLIGHT[key] = threading.Event()
         if ex is not None:
             try:
-                out = ex(*args)
+                with _otrace.span("dispatch", cache="hit"):
+                    out = ex(*args)
                 _CACHE_STATS.record_exec(True)
                 return out
             except Exception:
                 with _EXECUTABLES_LOCK:
                     _EXECUTABLES.pop(key, None)
                 _CACHE_STATS.record_exec(False, fallback=True)
-                return fn(*args)
+                with _otrace.span("dispatch", cache="fallback"):
+                    return fn(*args)
         if inflight is None:
             break  # this thread owns the compile
         # another thread is compiling this exact key: wait for it, then
@@ -158,15 +161,19 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
         # which serializes on jax's own compile cache anyway)
         if not inflight.wait(timeout=600.0):
             _CACHE_STATS.record_exec(False, fallback=True)
-            return fn(*args)
+            with _otrace.span("dispatch", cache="fallback"):
+                return fn(*args)
     t0 = time.perf_counter()
     try:
         try:
-            ex = _lower_and_compile(fn, args)
-            out = ex(*args)
+            with _otrace.span("compile"):
+                ex = _lower_and_compile(fn, args)
+            with _otrace.span("dispatch", cache="miss"):
+                out = ex(*args)
         except Exception:
             _CACHE_STATS.record_exec(False, fallback=True)
-            return fn(*args)
+            with _otrace.span("dispatch", cache="fallback"):
+                return fn(*args)
         _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
         with _EXECUTABLES_LOCK:
             _EXECUTABLES[key] = ex
@@ -559,11 +566,14 @@ def fetch_global(x):
     process cannot address, so it must be allgathered to every host
     first (a few hundred KB of per-shard winners, outside the hot
     loop). Single-process — the common case — stays a plain transfer."""
-    if jax.process_count() == 1:
-        return jax.device_get(x)
-    from jax.experimental import multihost_utils
+    with _otrace.span("device_transfer"):
+        if jax.process_count() == 1:
+            return jax.device_get(x)
+        from jax.experimental import multihost_utils
 
-    return jax.device_get(multihost_utils.process_allgather(x, tiled=True))
+        return jax.device_get(
+            multihost_utils.process_allgather(x, tiled=True)
+        )
 
 
 def best_of(best_a, best_k, curve=None):

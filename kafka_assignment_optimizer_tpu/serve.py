@@ -65,7 +65,18 @@ Endpoints:
 ``GET /metrics``
     Prometheus text counters: requests/solves/evaluates/errors/sheds,
     solve wall-clock totals, executable-cache hit/miss/compile-seconds
-    and solve-queue gauges (``kao_*``).
+    and solve-queue gauges (``kao_*``), plus per-phase solve latency
+    histograms aggregated from solve traces
+    (``kao_phase_seconds{phase=...}``).
+
+``GET /debug/solves`` / ``GET /debug/solves/<trace_id>``
+    Solve-trace telemetry (docs/OBSERVABILITY.md): every request gets a
+    trace ID (echoed as ``trace_id`` in the /submit response) and its
+    solve report — the span tree over the engine pipeline plus the
+    annealing trajectory summary — lands in a bounded ring buffer,
+    retrievable here until it ages out. ``--no-trace`` disables;
+    ``--profile-dir`` adds ``jax.profiler`` captures for the first N
+    solves per bucket.
 
 Concurrency: solves run on a bounded request queue drained by a small
 worker pool (``--workers`` / ``--queue-depth``) — overlapping submits
@@ -97,6 +108,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import landing
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
+from .obs import log as _olog
+from .obs import trace as _otrace
 
 # audits (/evaluate) hold their OWN lock (VERDICT r4 item 8): they are
 # pure host-side work (numpy + bound LPs + the native flow kernel — no
@@ -142,6 +155,42 @@ _BATCHABLE_OPTIONS = frozenset({
 # executable-accumulation hygiene: drop in-process jit caches after this
 # many completed solves (see _SolveQueue._maintenance)
 _CLEAR_CACHES_EVERY = 64
+
+# solve-trace telemetry (docs/OBSERVABILITY.md): every request gets a
+# trace ID; the solve runs under an ambient obs.trace span tree whose
+# report lands in the ring buffer behind GET /debug/solves/<trace_id>
+# and is echoed as "trace_id" in the response envelope. --no-trace
+# disables it (requests then carry no trace_id). --profile-dir
+# additionally wraps the first --profile-solves TPU solves per bucket
+# in a jax.profiler trace capture (XLA-level evidence next to the
+# span-level reports).
+OBS = {
+    "trace": True,
+    "profile_dir": None,
+    "profile_solves": 1,
+}
+_PROFILE_LOCK = threading.Lock()
+_PROFILED_BUCKETS: dict[tuple, int] = {}  # bucket key -> solves profiled
+
+
+def _profile_dir_for(bucket_key: tuple, trace_id: str | None) -> str | None:
+    """Claim one profiled solve for ``bucket_key`` if the per-bucket
+    budget (--profile-solves) has room; returns the capture directory
+    (unique per solve) or None."""
+    base = OBS["profile_dir"]
+    if not base:
+        return None
+    with _PROFILE_LOCK:
+        n = _PROFILED_BUCKETS.get(bucket_key, 0)
+        if n >= max(int(OBS["profile_solves"]), 0):
+            return None
+        _PROFILED_BUCKETS[bucket_key] = n + 1
+    import os
+
+    safe = "-".join(
+        str(x) for x in bucket_key if isinstance(x, (int, str))
+    ) or "default"
+    return os.path.join(base, safe, trace_id or _otrace.new_trace_id())
 
 
 class _QueueItem:
@@ -367,8 +416,14 @@ def _record_batch(size: int, waited_s: float, reports: list[dict]) -> None:
 
 
 def render_metrics() -> str:
+    # ONE atomic snapshot of everything behind _METRICS_LOCK: the
+    # dispatchers mutate _METRICS and _BATCH_SIZES while this renders,
+    # and two separate lock acquisitions let a batch land between them
+    # — torn reads where kao_batch_solves_total disagrees with its own
+    # size histogram (satellite fix, ISSUE 3)
     with _METRICS_LOCK:
         snap = dict(_METRICS)
+        sizes = dict(_BATCH_SIZES)
     # executable/bucket cache counters (solvers.tpu.bucket.STATS): the
     # operational evidence that shape bucketing is absorbing compiles —
     # kao_cache_exec_hits climbing while kao_cache_compiles_total stays
@@ -385,21 +440,49 @@ def render_metrics() -> str:
             snap[f"queue_{k}"] = v
     except Exception:
         pass
-    with _METRICS_LOCK:
-        sizes = dict(_BATCH_SIZES)
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
         kind = "counter" if k.endswith("_total") else "gauge"
+        lines.append(f"# HELP {name} {k.replace('_', ' ')} ({kind})")
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {v}")
     # batched-dispatch size histogram: one labeled sample per observed
     # size — the operational proof coalescing is (or is not) engaging
+    lines.append("# HELP kao_batch_size_total coalesced dispatch sizes")
     lines.append("# TYPE kao_batch_size_total counter")
     for size in sorted(sizes):
         lines.append(
             f'kao_batch_size_total{{size="{size}"}} {sizes[size]}'
         )
+    # per-phase solve latency histograms, aggregated from solve traces
+    # (obs.trace): which pipeline phase the wall-clock goes to, across
+    # every traced solve this process has served
+    phases = _otrace.phase_snapshot()
+    if phases:
+        lines.append(
+            "# HELP kao_phase_seconds solve pipeline phase latency "
+            "(from solve traces)"
+        )
+        lines.append("# TYPE kao_phase_seconds histogram")
+        for phase in sorted(phases):
+            row = phases[phase]
+            for le, n in row["buckets"]:
+                lines.append(
+                    f'kao_phase_seconds_bucket{{phase="{phase}",'
+                    f'le="{le}"}} {n}'
+                )
+            lines.append(
+                f'kao_phase_seconds_bucket{{phase="{phase}",'
+                f'le="+Inf"}} {row["count"]}'
+            )
+            lines.append(
+                f'kao_phase_seconds_sum{{phase="{phase}"}} {row["sum"]}'
+            )
+            lines.append(
+                f'kao_phase_seconds_count{{phase="{phase}"}} '
+                f'{row["count"]}'
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -559,29 +642,56 @@ class _Coalescer:
 def _run_batch_job(entries: list[dict]) -> list[dict]:
     """Worker-pool body of one coalesced dispatch: one batched lane
     solve, per-request response dicts out (same shape as /submit's
-    single-solve response)."""
+    single-solve response). The batch runs under ONE trace — the first
+    member's trace ID — and every member's response echoes that shared
+    ID, so any of them retrieves the batch's solve report."""
     from .api import optimize_batch
 
     t0 = time.perf_counter()
+    trace_id = next(
+        (e.get("trace_id") for e in entries if e.get("trace_id")), None
+    )
     opts = dict(entries[0]["options"])
     budgets = [e["options"].get("time_limit_s") for e in entries
                if e["options"].get("time_limit_s") is not None]
     if budgets:
         opts["time_limit_s"] = min(budgets)
-    outs = optimize_batch(
-        [e["current"] for e in entries],
-        [e["instance"] for e in entries],
-        seeds=[e["seed"] for e in entries],
-        **{k: v for k, v in opts.items() if k != "seed"},
-    )
+    tr = _otrace.begin(trace_id, name="request_batch",
+                       lanes=len(entries))
+    try:
+        outs = optimize_batch(
+            [e["current"] for e in entries],
+            [e["instance"] for e in entries],
+            seeds=[e["seed"] for e in entries],
+            **{k: v for k, v in opts.items() if k != "seed"},
+        )
+    except BaseException as e:
+        if tr is not None:
+            tr.root.set(error=repr(e)[:200])
+            _otrace.finish(tr)
+        _olog.error("batch_solve_failed", trace_id=trace_id,
+                    lanes=len(entries), error=repr(e)[:200])
+        raise
     dt = time.perf_counter() - t0
     with _METRICS_LOCK:
         _METRICS["solves_total"] += len(outs)
         _METRICS["solve_seconds_total"] += dt
         _METRICS["last_solve_seconds"] = dt
+    reps = [o.report() for o in outs]
+    if tr is not None:
+        tr.root.set(wall_s=round(dt, 4),
+                    lanes_feasible=sum(
+                        1 for r in reps if r.get("feasible")))
+        _otrace.finish(tr)
+    _olog.log("solve_batch", trace_id=trace_id, lanes=len(outs),
+              wall_s=round(dt, 4))
     return [
-        {"assignment": o.assignment.to_dict(), "report": o.report()}
-        for o in outs
+        {
+            "assignment": o.assignment.to_dict(),
+            "report": rep,
+            **({"trace_id": trace_id} if trace_id else {}),
+        }
+        for o, rep in zip(outs, reps)
     ]
 
 
@@ -690,6 +800,10 @@ def handle_submit(
             max_solve_s if limit is None else min(float(limit), max_solve_s)
         )
 
+    # request-scoped trace ID: generated here, propagated into the
+    # solve (ambient obs.trace), echoed in the response envelope, and
+    # retrievable via GET /debug/solves/<trace_id>
+    trace_id = _otrace.new_trace_id() if OBS["trace"] else None
     try:
         # coalescing path: explicit TPU solves whose knobs the batched
         # lane solver understands may ride a shared dispatch. The
@@ -697,6 +811,7 @@ def handle_submit(
         # group key is the EXACT executable identity; the single-solve
         # path below reuses it either way.
         inst = None
+        bucket_key = None
         if (
             solver == "tpu"
             and _COALESCER.enabled()
@@ -709,8 +824,9 @@ def handle_submit(
             non_seed = tuple(sorted(
                 (k, v) for k, v in options.items() if k != "seed"
             ))
-            key = (inst.num_brokers, inst.num_racks,
-                   *bucket.bucket_shape(inst), non_seed)
+            bucket_key = (inst.num_brokers, inst.num_racks,
+                          *bucket.bucket_shape(inst))
+            key = (*bucket_key, non_seed)
             if not _COALESCER.should_bypass(key):
                 return _COALESCER.submit(
                     key,
@@ -718,6 +834,7 @@ def handle_submit(
                         "current": current,
                         "instance": inst,
                         "seed": options.get("seed", 0),
+                        "trace_id": trace_id,
                         "options": {k: v for k, v in options.items()
                                     if k != "seed"},
                     },
@@ -725,21 +842,61 @@ def handle_submit(
                     budget_s=options.get("time_limit_s"),
                 )
 
+        # profiling needs the bucket identity even when the request was
+        # not coalescing-eligible (non-batchable knobs, --max-batch 1):
+        # build the instance now — the solve reuses it — so each bucket
+        # draws on ITS OWN --profile-solves budget, per the contract
+        if solver == "tpu" and OBS["profile_dir"] and bucket_key is None:
+            from .models.instance import build_instance
+            from .solvers.tpu import bucket
+
+            inst = build_instance(current, brokers, topology, rf)
+            bucket_key = (inst.num_brokers, inst.num_racks,
+                          *bucket.bucket_shape(inst))
+
         def _solve_job():
             t0 = time.perf_counter()
-            res = optimize(
-                current, brokers, topology, target_rf=rf, solver=solver,
-                instance=inst, **options,
-            )
+            kw = dict(options)
+            if solver == "tpu" and bucket_key is not None:
+                prof = _profile_dir_for(bucket_key, trace_id)
+                if prof:
+                    kw["profile_dir"] = prof
+            tr = _otrace.begin(trace_id, name="request", solver=solver)
+            try:
+                res = optimize(
+                    current, brokers, topology, target_rf=rf,
+                    solver=solver, instance=inst, **kw,
+                )
+            except BaseException as e:
+                if tr is not None:
+                    tr.root.set(error=repr(e)[:200])
+                    _otrace.finish(tr)
+                _olog.error("solve_failed", trace_id=trace_id,
+                            solver=solver, error=repr(e)[:200])
+                raise
             dt = time.perf_counter() - t0
             with _METRICS_LOCK:
                 _METRICS["solves_total"] += 1
                 _METRICS["solve_seconds_total"] += dt
                 _METRICS["last_solve_seconds"] = dt
-            return {
+            rep = res.report()
+            if tr is not None:
+                tr.root.set(solver=res.solve.solver,
+                            feasible=rep.get("feasible"),
+                            replica_moves=rep.get("replica_moves"),
+                            wall_s=round(dt, 4))
+                _otrace.finish(tr)
+            _olog.log("solve", trace_id=trace_id, solver=res.solve.solver,
+                      wall_s=round(dt, 4), feasible=rep.get("feasible"),
+                      moves=rep.get("replica_moves"),
+                      proved_optimal=rep.get("proven_optimal"))
+            out = {
                 "assignment": res.assignment.to_dict(),
-                "report": res.report(),
+                "report": rep,
             }
+            if trace_id:
+                out["trace_id"] = trace_id
+            return out
 
         return _SOLVES.submit(
             _solve_job, wait_s=lock_wait_s,
@@ -825,6 +982,12 @@ def handle_healthz() -> dict:
             "enabled": _COALESCER.enabled(),
             "window_ms": round(_COALESCER.window_s * 1e3, 3),
             "max_batch": _COALESCER.max_batch,
+        },
+        "observability": {
+            "trace_enabled": bool(OBS["trace"]),
+            "solve_reports_held": len(_otrace.RECENT.ids()),
+            "report_ring_capacity": _otrace.RECENT.capacity,
+            "profile_dir": OBS["profile_dir"],
         },
     }
 
@@ -1007,12 +1170,14 @@ def start_warmup_thread(shapes: list[dict], *, engine: str = "sweep",
                 lock_wait_s=3600.0, max_solve_s=max_solve_s,
             )
             for row in out["warmed"]:
-                print(f"[kao] warmup {row['shape']} -> bucket "
-                      f"({row['bucket_parts']}, {row['bucket_rf']}) "
-                      f"in {row['wall_s']}s "
-                      f"(compiles={row['compiles']})", file=sys.stderr)
+                _olog.log(
+                    "warmup", shape=str(row["shape"]),
+                    bucket_parts=row["bucket_parts"],
+                    bucket_rf=row["bucket_rf"], wall_s=row["wall_s"],
+                    compiles=row["compiles"],
+                )
         except Exception as e:  # warmup is best-effort, never fatal
-            print(f"[kao] warmup failed: {e}", file=sys.stderr)
+            _olog.warn("warmup_failed", error=repr(e)[:200])
 
     t = threading.Thread(target=run, daemon=True, name="kao-warmup")
     t.start()
@@ -1067,6 +1232,20 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif route == "/debug/solves":
+            # most-recent-first listing of retrievable solve reports
+            self._send(200, {"trace_ids": _otrace.RECENT.ids()})
+        elif route.startswith("/debug/solves/"):
+            tid = route.rsplit("/", 1)[1]
+            rep = _otrace.RECENT.get(tid)
+            if rep is None:
+                self._send(404, {
+                    "error": f"no solve report for trace_id {tid!r} "
+                             f"(ring holds the last "
+                             f"{_otrace.RECENT.capacity} traced solves)",
+                })
+            else:
+                self._send(200, rep)
         else:
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
@@ -1174,6 +1353,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="persistent XLA compile-cache directory "
                          "(sets KAO_JIT_CACHE, so warmth survives "
                          "process restarts)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable per-request solve traces (responses "
+                         "then carry no trace_id and /debug/solves "
+                         "stays empty)")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the first "
+                         "--profile-solves TPU solves per bucket under "
+                         "this directory (XLA-level traces next to the "
+                         "span-level solve reports)")
+    ap.add_argument("--profile-solves", type=int, default=1,
+                    metavar="N",
+                    help="profiled solves per bucket with "
+                         "--profile-dir (default 1)")
     args = ap.parse_args(argv)
     if args.lock_wait_s < 0:
         ap.error("--lock-wait-s must be >= 0")
@@ -1197,9 +1389,14 @@ def main(argv: list[str] | None = None) -> int:
         import os
 
         os.environ["KAO_JIT_CACHE"] = args.jit_cache
+    if args.profile_solves < 0:
+        ap.error("--profile-solves must be >= 0")
     from .utils.platform import pin_platform
 
     pin_platform()
+    OBS["trace"] = not args.no_trace
+    OBS["profile_dir"] = args.profile_dir
+    OBS["profile_solves"] = args.profile_solves
     _SOLVES.configure(workers=args.workers, depth=args.queue_depth)
     _COALESCER.configure(window_ms=args.batch_window_ms,
                          max_batch=args.max_batch)
@@ -1212,7 +1409,8 @@ def main(argv: list[str] | None = None) -> int:
         start_warmup_thread(
             warmup_shapes, max_solve_s=args.max_solve_s or None
         )
-    print(f"listening on http://{args.host}:{srv.server_address[1]}", file=sys.stderr)
+    _olog.log("listening", host=args.host, port=srv.server_address[1],
+              workers=args.workers, trace_enabled=OBS["trace"])
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

@@ -24,6 +24,8 @@ import numpy as np
 
 from ...models import instance as _instance_mod
 from ...models.instance import ProblemInstance
+from ...obs import log as _olog
+from ...obs import trace as _otrace
 from ...utils import checkpoint as ckpt
 from ..base import SolveResult, register
 from . import arrays
@@ -118,7 +120,34 @@ def _defaults(inst: ProblemInstance, platform: str, engine: str | None) -> dict:
 
 
 @register("tpu")
-def solve_tpu(
+def solve_tpu(inst: ProblemInstance, *args,
+              trace: bool | str | None = None, **kwargs) -> SolveResult:
+    """Traced entry point: ``trace=True`` (or a trace-ID string) records
+    a span-level solve report (``obs.trace``) attached to the result as
+    ``stats["solve_report"]`` and registered in the ``/debug/solves``
+    ring buffer. Default is untraced — zero telemetry overhead — but an
+    AMBIENT trace (the serving path wraps each request in one) still
+    collects this solve's phase spans; the trace_id then lands in stats
+    so the response can echo it."""
+    tr = _otrace.begin(trace, name="solve_tpu")
+    if tr is None:
+        res = _solve_tpu(inst, *args, **kwargs)
+        tid = _otrace.current_trace_id()
+        if tid:
+            res.stats.setdefault("trace_id", tid)
+        return res
+    try:
+        res = _solve_tpu(inst, *args, **kwargs)
+    except BaseException as e:
+        tr.root.set(error=repr(e)[:200])
+        _otrace.finish(tr)
+        raise
+    res.stats["trace_id"] = tr.trace_id
+    res.stats["solve_report"] = _otrace.finish(tr)
+    return res
+
+
+def _solve_tpu(
     inst: ProblemInstance,
     seed: int = 0,
     batch: int | None = None,
@@ -172,9 +201,10 @@ def solve_tpu(
     # thread — unlike a ThreadPoolExecutor worker — cannot stall
     # interpreter exit if the solve dies while a 50k-partition LP is
     # still grinding.)
-    bounds_fut = _BoundsTask(
-        lambda: (inst.move_lower_bound_exact(), inst.weight_upper_bound())
-    )
+    bounds_fut = _BoundsTask(_otrace.wrap(
+        "bounds",
+        lambda: (inst.move_lower_bound_exact(), inst.weight_upper_bound()),
+    ))
     # when balance bands bind, a second worker decodes the kept-replica
     # LP into a plan (solvers.lp_round) — usually the certified global
     # optimum, letting the solve skip annealing (and often compilation)
@@ -217,10 +247,12 @@ def solve_tpu(
         lp_wait_s = 0.0
     elif not multi and (_caps_bind(inst) or big or inst.agg_effective()):
         reseat_ok = _RESEAT_RACE and not knobs_set
-        lp_fut = _BoundsTask(
+        lp_fut = _BoundsTask(_otrace.wrap(
+            "construct_worker",
             lambda: _construct_worker(inst, bounds_fut,
-                                      reseat_fallback=reseat_ok)
-        )
+                                      reseat_fallback=reseat_ok),
+            path="lp",
+        ))
         # past the aggregation threshold the constructor (agg MILP +
         # completion + exact reseat, ~15-20 s) is far cheaper than the
         # first sweep-executable compile (minutes), so waiting longer
@@ -234,14 +266,20 @@ def solve_tpu(
         and inst.num_parts <= _EXACT_RACE_PARTS
         and 2 * inst.num_brokers * inst.num_parts <= _EXACT_RACE_VARS
     ):
-        lp_fut = _BoundsTask(lambda: _exact_worker(inst, bounds_fut))
+        lp_fut = _BoundsTask(_otrace.wrap(
+            "construct_worker",
+            lambda: _exact_worker(inst, bounds_fut), path="milp",
+        ))
         lp_wait_s = _CONSTRUCT_WAIT_S
     elif not multi and not knobs_set and _RESEAT_RACE:
         # slack caps, no symmetry, too big for the exact MILP — the
         # adversarial class. Greedy + exact reseat races the annealer:
         # certified it skips the search entirely; uncertified it still
         # hands the ladder a better warm start than the raw greedy
-        lp_fut = _BoundsTask(lambda: _reseat_worker(inst, bounds_fut))
+        lp_fut = _BoundsTask(_otrace.wrap(
+            "construct_worker",
+            lambda: _reseat_worker(inst, bounds_fut), path="reseat",
+        ))
         lp_wait_s = (
             _CONSTRUCT_WAIT_MID_S
             if members > _RESEAT_WAIT_MID_MEMBERS
@@ -281,14 +319,19 @@ def solve_tpu(
         # engine-neutral knobs carry over; the budget knobs
         # (rounds/sweeps/steps_per_round) deliberately do NOT — each
         # engine's budget is meaningless for the other (see _defaults),
-        # so the retry runs the chain engine's own defaults
-        res2 = solve_tpu(
-            inst, seed=seed, engine="chain", n_devices=n_devices,
-            batch=batch_arg, t_hi=t_hi_arg, t_lo=t_lo_arg,
-            checkpoint=checkpoint, profile_dir=profile_dir,
-            time_limit_s=remaining,
-            cert_min_savings_s=cert_min_savings_s,
-        )
+        # so the retry runs the chain engine's own defaults. Under an
+        # active trace the retry's pipeline spans nest under this
+        # "retry" span, keeping the root-level phases exactly-once.
+        _olog.warn("engine_fallback_retry", engine="chain",
+                   parts=inst.num_parts)
+        with _otrace.span("retry", engine="chain"):
+            res2 = solve_tpu(
+                inst, seed=seed, engine="chain", n_devices=n_devices,
+                batch=batch_arg, t_hi=t_hi_arg, t_lo=t_lo_arg,
+                checkpoint=checkpoint, profile_dir=profile_dir,
+                time_limit_s=remaining,
+                cert_min_savings_s=cert_min_savings_s,
+            )
         def rank(r):
             return (
                 r.stats["feasible"],
@@ -607,30 +650,57 @@ def _run_ladder(
                     sweep_state = new_state
                 return pop_a, pop_k, curve
 
-            try:
-                r.pop_a, r.pop_k, curve = run_chunk()
-            except Exception as e:
-                # only a Mosaic/Pallas lowering failure warrants the XLA
-                # retry; anything else (OOM, sharding bug, regression)
-                # must surface with its real traceback
-                msg = f"{type(e).__name__}: {e}"
-                is_lowering = r.scorer == "pallas" and any(
-                    s in msg for s in ("Mosaic", "mosaic", "pallas",
-                                       "Pallas", "lowering", "Lowering")
-                )
-                if not is_lowering:
-                    raise
-                r.pallas_fallback = repr(e)[:500]
-                r.scorer = "xla"
-                r.pop_a, r.pop_k, curve = run_chunk()
-            chunk_s = time.perf_counter() - tc
-            if i > 0:
-                warm_chunk_s = (
-                    chunk_s if warm_chunk_s is None
-                    else min(warm_chunk_s, chunk_s)
-                )
-            r.rounds_run += temps.shape[0]
-            r.curves.append(np.asarray(fetch_global(curve)))
+            with _otrace.span("chunk", index=i) as _sp:
+                try:
+                    r.pop_a, r.pop_k, curve = run_chunk()
+                except Exception as e:
+                    # only a Mosaic/Pallas lowering failure warrants the
+                    # XLA retry; anything else (OOM, sharding bug,
+                    # regression) must surface with its real traceback
+                    msg = f"{type(e).__name__}: {e}"
+                    is_lowering = r.scorer == "pallas" and any(
+                        s in msg for s in ("Mosaic", "mosaic", "pallas",
+                                           "Pallas", "lowering", "Lowering")
+                    )
+                    if not is_lowering:
+                        raise
+                    r.pallas_fallback = repr(e)[:500]
+                    r.scorer = "xla"
+                    _olog.warn("pallas_fallback", chunk=i,
+                               error=repr(e)[:200])
+                    r.pop_a, r.pop_k, curve = run_chunk()
+                chunk_s = time.perf_counter() - tc
+                if i > 0:
+                    warm_chunk_s = (
+                        chunk_s if warm_chunk_s is None
+                        else min(warm_chunk_s, chunk_s)
+                    )
+                r.rounds_run += temps.shape[0]
+                r.curves.append(np.asarray(fetch_global(curve)))
+                if _sp is not None:
+                    # per-chunk annealing stats: the best-score curve is
+                    # the exact record the device already returns, so
+                    # accepts/declines are measured at best-curve
+                    # granularity (rounds that did / did not improve the
+                    # global best) — no extra device outputs, trajectory
+                    # bit-parity untouched
+                    t_np = np.asarray(temps)
+                    best = r.curves[-1].max(axis=0)
+                    imp = (
+                        int((np.diff(best) > 0).sum())
+                        if best.size > 1 else 0
+                    )
+                    _sp.set(
+                        rounds=int(t_np.shape[0]),
+                        t_hi=float(t_np[0]),
+                        t_lo=float(t_np[-1]),
+                        scorer=r.scorer,
+                        dispatch_s=round(chunk_s, 4),
+                        energy_before=int(best[0]) if best.size else None,
+                        energy_after=int(best[-1]) if best.size else None,
+                        accepts=imp,
+                        declines=max(0, int(best.size) - 1 - imp),
+                    )
             if i + 1 < len(chunks):
                 # a finished constructor worker short-circuits the rest
                 # of the ladder with its certified plan
@@ -979,9 +1049,22 @@ def _solve_tpu_inner(
     if multi:
         time_limit_s = None
 
-    certified_a, lp_warm, lp_warm_extends = _await_constructor(
-        lp_fut, lp_wait_s, checkpoint, t0, time_limit_s
-    )
+    # pipeline phase spans (obs.trace): every stage gets exactly one
+    # span on every path — stages that do not run emit a zero-duration
+    # span tagged skipped=True, so the span tree's phase vocabulary
+    # (bounds/constructor/seed/ladder/polish/verify) is complete in
+    # every solve report regardless of which shortcut fired
+    with _otrace.span("constructor") as _sp:
+        certified_a, lp_warm, lp_warm_extends = _await_constructor(
+            lp_fut, lp_wait_s, checkpoint, t0, time_limit_s
+        )
+        if _sp is not None:
+            _sp.set(
+                skipped=lp_fut is None,
+                wait_budget_s=lp_wait_s,
+                certified=certified_a is not None,
+                warm_start=lp_warm is not None,
+            )
     if certified_a is not None:
         early_stopped = True
         constructed = True
@@ -1028,9 +1111,14 @@ def _solve_tpu_inner(
         steps_per_round_ignored = False
 
     if certified_a is None:
-        a_seed, resumed = _pick_seed(inst, lp_warm, lp_warm_extends,
-                                     checkpoint)
+        with _otrace.span("seed") as _sp:
+            a_seed, resumed = _pick_seed(inst, lp_warm, lp_warm_extends,
+                                         checkpoint)
+            if _sp is not None:
+                _sp.set(resumed_from_checkpoint=resumed,
+                        warm_start_extends_greedy=bool(lp_warm_extends))
     else:
+        _otrace.mark("seed", skipped=True)
         a_seed = certified_a  # never dispatched: the ladder is empty
         resumed = False
     # shape bucketing: lower the model padded up to its canonical bucket
@@ -1126,15 +1214,22 @@ def _solve_tpu_inner(
         polish_fut_box.append(_BoundsTask(_aot_polish))
 
     if chunks:
-        lad = _run_ladder(
-            inst, m, mesh, chains_per_device, rounds, steps_per_round,
-            engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
-            bounds_fut, multi, cert_min_savings_s, t0, time_limit_s,
-            profile_dir, polish_starter=_start_polish_aot,
-        )
+        with _otrace.span("ladder", engine=engine,
+                          chunks=len(chunks)) as _sp:
+            lad = _run_ladder(
+                inst, m, mesh, chains_per_device, rounds, steps_per_round,
+                engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
+                bounds_fut, multi, cert_min_savings_s, t0, time_limit_s,
+                profile_dir, polish_starter=_start_polish_aot,
+            )
+            if _sp is not None:
+                _sp.set(rounds_run=lad.rounds_run,
+                        timed_out=lad.timed_out, scorer=lad.scorer,
+                        boundary_certified=lad.certified_a is not None)
     else:
         # constructed fast path: the ladder never runs, and calling into
         # it would import device-adjacent modules this path avoids
+        _otrace.mark("ladder", skipped=True)
         lad = _LadderResult(scorer=scorer)
     polish_fut = polish_fut_box[0] if polish_fut_box else None
     pop_a, pop_k = lad.pop_a, lad.pop_k
@@ -1151,65 +1246,97 @@ def _solve_tpu_inner(
         np.concatenate(lad.curves, axis=1) if lad.curves
         else np.zeros((1, 0), dtype=np.int64)
     )
+    # best-score trajectory (max over shards): stats' score_curve and
+    # the solve report's annealing summary share one computation
+    best_curve = np.asarray(jax.device_get(curve)).max(axis=0)
+    if _otrace.active():
+        _imp = (
+            int((np.diff(best_curve) > 0).sum())
+            if best_curve.size > 1 else 0
+        )
+        _otrace.set_trajectory(
+            engine=engine,
+            rounds=int(best_curve.size),
+            energy_curve=_downsample(best_curve, 64),
+            improved_rounds=_imp,
+            plateau_rounds=max(0, int(best_curve.size) - 1 - _imp),
+        )
 
     if certified_a is not None:
         # a chunk-boundary candidate already carries the optimality
         # certificate — selection and polish cannot improve a proven
         # global optimum
+        _otrace.mark("polish", skipped=True)
         best_a = np.asarray(certified_a, dtype=np.int32)
     else:
-        best_a, final_cert, lp_won = _final_selection(
-            inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
-            t0, time_limit_s, multi,
-        )
+        # the "polish" phase span covers all of final selection: the
+        # device rescore, the certify-first attempt, and (only on
+        # certificate failure) the steepest-descent polish itself —
+        # final_cert names which of those actually ran
+        with _otrace.span("polish") as _sp:
+            best_a, final_cert, lp_won = _final_selection(
+                inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
+                t0, time_limit_s, multi,
+            )
+            if _sp is not None:
+                _sp.set(final_cert=final_cert, lp_plan_won=lp_won)
         constructed = constructed or lp_won
     t_polish = time.perf_counter()
 
     # host-side exact verification (SURVEY.md §4.3 property): the engine's
     # incremental scores must agree with the numpy oracle
-    viol = inst.violations(best_a)
-    weight = inst.preservation_weight(best_a)
-    feasible = all(v == 0 for v in viol.values())
-
-    if checkpoint:
-        ckpt.save(
-            checkpoint,
-            inst,
-            best_a,
-            meta={
-                "objective": int(weight),
-                "feasible": feasible,
-                "moves": int(inst.move_count(best_a)),
-                "engine": engine,
-            },
-        )
-
-    moves_final = int(inst.move_count(best_a))
-    # optimality certificate: when the final plan meets both bounds it
-    # is a PROVEN global optimum (weight is the primary objective, moves
-    # the tie-break, and no feasible plan can beat either bound). A
-    # boundary-certified plan already holds the proof; otherwise join
-    # the prefetched bounds — bounded by any remaining deadline budget
-    # so a timed-out solve is not stalled by a straggling LP — and
-    # re-derive it. The synchronous tier-1 escalation inside
-    # certify_optimal is allowed only when no deadline is in play.
-    if certified_a is not None:
-        proved_optimal = True
-    else:
-        try:
-            timeout = _budget_left(t0, time_limit_s)
-            bounds_fut.result(timeout=timeout)
-            if tight_fut is not None:
-                # a tier-1 LP is already running on the worker: join it
-                # (budget-bounded) rather than letting certify_optimal
-                # recompute the same multi-second LP on this thread
-                tight_fut.result(timeout=timeout)
-            proved_optimal = inst.certify_optimal(
+    with _otrace.span("verify") as _sp:
+        viol = inst.violations(best_a)
+        weight = inst.preservation_weight(best_a)
+        feasible = all(v == 0 for v in viol.values())
+        moves_final = int(inst.move_count(best_a))
+        if checkpoint:
+            # persist BEFORE the certification joins below: with no
+            # deadline they may block on a straggling LP, and a solve
+            # killed in that window must not lose its plan
+            ckpt.save(
+                checkpoint,
+                inst,
                 best_a,
-                allow_tight=time_limit_s is None or tight_fut is not None,
+                meta={
+                    "objective": int(weight),
+                    "feasible": feasible,
+                    "moves": moves_final,
+                    "engine": engine,
+                },
             )
-        except Exception:
-            proved_optimal = False
+        # optimality certificate: when the final plan meets both bounds
+        # it is a PROVEN global optimum (weight is the primary
+        # objective, moves the tie-break, and no feasible plan can beat
+        # either bound). A boundary-certified plan already holds the
+        # proof; otherwise join the prefetched bounds — bounded by any
+        # remaining deadline budget so a timed-out solve is not stalled
+        # by a straggling LP — and re-derive it. The synchronous tier-1
+        # escalation inside certify_optimal is allowed only when no
+        # deadline is in play.
+        if certified_a is not None:
+            proved_optimal = True
+        else:
+            try:
+                timeout = _budget_left(t0, time_limit_s)
+                bounds_fut.result(timeout=timeout)
+                if tight_fut is not None:
+                    # a tier-1 LP is already running on the worker: join
+                    # it (budget-bounded) rather than letting
+                    # certify_optimal recompute the same multi-second LP
+                    # on this thread
+                    tight_fut.result(timeout=timeout)
+                proved_optimal = inst.certify_optimal(
+                    best_a,
+                    allow_tight=(
+                        time_limit_s is None or tight_fut is not None
+                    ),
+                )
+            except Exception:
+                proved_optimal = False
+        if _sp is not None:
+            _sp.set(feasible=feasible, violations=sum(viol.values()),
+                    moves=moves_final, proved_optimal=proved_optimal)
 
     return SolveResult(
         a=best_a,
@@ -1286,9 +1413,7 @@ def _solve_tpu_inner(
             "resumed_from_checkpoint": resumed,
             # best-score trajectory (max over shards, downsampled): the
             # convergence record SURVEY.md §5 calls for
-            "score_curve": _downsample(
-                np.asarray(jax.device_get(curve)).max(axis=0), 32
-            ),
+            "score_curve": _downsample(best_curve, 32),
         },
     )
 
@@ -1306,6 +1431,7 @@ def solve_tpu_batch(
     n_devices: int | None = None,
     time_limit_s: float | None = None,
     certify: bool = False,
+    trace: bool | str | None = None,
 ) -> list[SolveResult]:
     """Solve L independent instances in ONE batched device dispatch —
     the multi-tenant throughput path (serve's coalescing dispatcher and
@@ -1338,7 +1464,12 @@ def solve_tpu_batch(
     the uncut ladder) and the wall clock is checked between chunks; a
     batch out of budget stops early with ``stats["timed_out"]`` and
     returns the per-lane bests found so far (never worse than each
-    lane's seed)."""
+    lane's seed).
+
+    ``trace`` records ONE span-level solve report for the whole batch
+    (obs.trace): every lane's stats carry the shared ``trace_id`` and
+    ``solve_report``, and the report registers in the /debug/solves
+    ring buffer."""
     t0 = time.perf_counter()
     if not insts:
         return []
@@ -1350,21 +1481,66 @@ def solve_tpu_batch(
         )
     L = len(insts)
     axes = {(i.num_brokers, i.num_racks) for i in insts}
-    if len(axes) > 1:
-        out = []
-        for inst, s in zip(insts, seeds):
-            r = solve_tpu(inst, seed=s, engine=engine, batch=batch,
-                          rounds=rounds, sweeps=sweeps, t_hi=t_hi,
-                          t_lo=t_lo, n_devices=n_devices,
-                          time_limit_s=time_limit_s)
-            r.stats["lane_fallback"] = "brokers/racks differ across lanes"
-            out.append(r)
-        return out
 
-    from ...parallel.mesh import fetch_global, make_mesh, solve_lanes
-    from ...utils.platform import enable_compile_cache, ensure_backend
-    from . import bucket
+    # one trace covers the whole call — batched dispatch or the
+    # unstackable sequential fallback alike — so trace=True always
+    # honors the documented contract: a shared report/trace_id on
+    # every lane's stats
+    tr = _otrace.begin(trace, name="solve_tpu_batch", lanes=L)
+    try:
+        if len(axes) > 1:
+            results = []
+            for i, (inst, s) in enumerate(zip(insts, seeds)):
+                # each sequential solve's pipeline spans nest under a
+                # per-lane span, keeping the shared report readable
+                with _otrace.span("lane", index=i):
+                    r = solve_tpu(inst, seed=s, engine=engine,
+                                  batch=batch, rounds=rounds,
+                                  sweeps=sweeps, t_hi=t_hi, t_lo=t_lo,
+                                  n_devices=n_devices,
+                                  time_limit_s=time_limit_s)
+                r.stats["lane_fallback"] = (
+                    "brokers/racks differ across lanes"
+                )
+                results.append(r)
+        else:
+            from ...parallel.mesh import (
+                fetch_global, make_mesh, solve_lanes,
+            )
+            from ...utils.platform import (
+                enable_compile_cache, ensure_backend,
+            )
+            from . import bucket
 
+            results = _solve_batch_body(
+                insts, seeds, engine, batch, rounds, sweeps, t_hi, t_lo,
+                n_devices, time_limit_s, certify, t0, L,
+                fetch_global, make_mesh, solve_lanes,
+                enable_compile_cache, ensure_backend, bucket,
+            )
+    except BaseException as e:
+        if tr is not None:
+            tr.root.set(error=repr(e)[:200])
+            _otrace.finish(tr)
+        raise
+    if tr is not None:
+        rep = _otrace.finish(tr)
+        for r in results:
+            r.stats["trace_id"] = tr.trace_id
+            r.stats["solve_report"] = rep
+    else:
+        tid = _otrace.current_trace_id()
+        if tid:
+            for r in results:
+                r.stats.setdefault("trace_id", tid)
+    return results
+
+
+def _solve_batch_body(
+    insts, seeds, engine, batch, rounds, sweeps, t_hi, t_lo, n_devices,
+    time_limit_s, certify, t0, L, fetch_global, make_mesh, solve_lanes,
+    enable_compile_cache, ensure_backend, bucket,
+) -> list[SolveResult]:
     for inst in insts:
         inst._bounds_cancelled = False
         inst._construct_path = None
@@ -1385,6 +1561,12 @@ def solve_tpu_batch(
     if t_lo is None:
         t_lo = 0.02 if engine == "sweep" else 0.05
 
+    # the batch path deliberately runs no bounds prefetch, constructor
+    # race, or polish (see the docstring) — the skipped marks keep the
+    # span tree's phase vocabulary uniform with the single-solve path
+    _otrace.mark("bounds", skipped=True)
+    _otrace.mark("constructor", skipped=True)
+    _otrace.mark("polish", skipped=True)
     # one COMMON bucket for the whole batch: the max rung over lanes, so
     # every lane's arrays share one padded shape (the stacking invariant)
     bkt_parts = max(bucket.part_bucket(i.num_parts) for i in insts)
@@ -1392,21 +1574,25 @@ def solve_tpu_batch(
     B, K = insts[0].num_brokers, insts[0].num_racks
     models = []
     lane_seeds = np.empty((L, bkt_parts, bkt_rf), np.int32)
-    for i, inst in enumerate(insts):
-        bucket.STATS.record_bucket(
-            (B, K, bkt_parts, bkt_rf),
-            padded=(bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf),
-        )
-        m = arrays.from_instance(inst, num_parts=bkt_parts, max_rf=bkt_rf)
-        models.append(m)
-        a_seed = np.asarray(greedy_seed(inst), dtype=np.int32)
-        assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
-            "seed left unfilled slots"
-        )
-        lane_seeds[i] = arrays.pad_candidate(a_seed, m)
-    m_stack = arrays.stack_models(models)
-    seed_moves = [int(inst.move_count(arrays.unpad_candidate(
-        lane_seeds[i], inst))) for i, inst in enumerate(insts)]
+    with _otrace.span("seed", lanes=L):
+        for i, inst in enumerate(insts):
+            bucket.STATS.record_bucket(
+                (B, K, bkt_parts, bkt_rf),
+                padded=(
+                    (bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf)
+                ),
+            )
+            m = arrays.from_instance(inst, num_parts=bkt_parts,
+                                     max_rf=bkt_rf)
+            models.append(m)
+            a_seed = np.asarray(greedy_seed(inst), dtype=np.int32)
+            assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
+                "seed left unfilled slots"
+            )
+            lane_seeds[i] = arrays.pad_candidate(a_seed, m)
+        m_stack = arrays.stack_models(models)
+        seed_moves = [int(inst.move_count(arrays.unpad_candidate(
+            lane_seeds[i], inst))) for i, inst in enumerate(insts)]
 
     mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
@@ -1444,50 +1630,67 @@ def solve_tpu_batch(
         jax.block_until_ready(pa)
         return new_state, pa, pk, cv
 
-    for ci, chunk_temps in enumerate(chunks):
-        if deadline is not None and ci > 1 and warm_chunk_s is not None:
-            # chunk 0 is compile-inclusive; only warm chunk times gate
-            if deadline - time.perf_counter() < warm_chunk_s * 0.9:
-                timed_out = True
+    with _otrace.span("ladder", engine=engine,
+                      chunks=len(chunks)) as _lsp:
+        for ci, chunk_temps in enumerate(chunks):
+            if (deadline is not None and ci > 1
+                    and warm_chunk_s is not None):
+                # chunk 0 is compile-inclusive; only warm chunks gate
+                if deadline - time.perf_counter() < warm_chunk_s * 0.9:
+                    timed_out = True
+                    break
+            tc = time.perf_counter()
+            with _otrace.span("chunk", index=ci) as _sp:
+                try:
+                    state, pop_a, pop_k, cv = run_chunk(
+                        scorer, chunk_temps, state
+                    )
+                except Exception as e:
+                    msg = f"{type(e).__name__}: {e}"
+                    is_lowering = scorer == "pallas" and any(
+                        s in msg for s in ("Mosaic", "mosaic", "pallas",
+                                           "Pallas", "lowering",
+                                           "Lowering")
+                    )
+                    if not is_lowering:
+                        raise
+                    pallas_fallback = repr(e)[:500]
+                    scorer = "xla"
+                    _olog.warn("pallas_fallback", chunk=ci,
+                               error=repr(e)[:200])
+                    state, pop_a, pop_k, cv = run_chunk(
+                        scorer, chunk_temps, state
+                    )
+                chunk_s = time.perf_counter() - tc
+                if _sp is not None:
+                    t_np = np.asarray(chunk_temps)
+                    _sp.set(rounds=int(t_np.shape[0]),
+                            t_hi=float(t_np[0]), t_lo=float(t_np[-1]),
+                            scorer=scorer, dispatch_s=round(chunk_s, 4))
+            if ci > 0:
+                warm_chunk_s = (
+                    chunk_s if warm_chunk_s is None
+                    else min(warm_chunk_s, chunk_s)
+                )
+            rounds_run += int(chunk_temps.shape[0])
+            curves.append(cv)
+            over = deadline is not None and time.perf_counter() > deadline
+            if engine != "sweep" and ci + 1 < len(chunks) and not over:
+                # chain boundary reseed: each lane continues from its
+                # best shard winner with a fresh per-lane key stream
+                pa_np = np.asarray(fetch_global(pop_a))
+                pk_np = np.asarray(fetch_global(pop_k))
+                top = pk_np.argmax(axis=0)  # [L]
+                cur_seeds = np.stack(
+                    [pa_np[top[i], i] for i in range(L)]
+                ).astype(np.int32)
+                cur_keys = jax.vmap(jax.random.split)(cur_keys)[:, 1]
+            if over:
+                timed_out = ci + 1 < len(chunks)
                 break
-        tc = time.perf_counter()
-        try:
-            state, pop_a, pop_k, cv = run_chunk(scorer, chunk_temps,
-                                                state)
-        except Exception as e:
-            msg = f"{type(e).__name__}: {e}"
-            is_lowering = scorer == "pallas" and any(
-                s in msg for s in ("Mosaic", "mosaic", "pallas",
-                                   "Pallas", "lowering", "Lowering")
-            )
-            if not is_lowering:
-                raise
-            pallas_fallback = repr(e)[:500]
-            scorer = "xla"
-            state, pop_a, pop_k, cv = run_chunk(scorer, chunk_temps,
-                                                state)
-        chunk_s = time.perf_counter() - tc
-        if ci > 0:
-            warm_chunk_s = (
-                chunk_s if warm_chunk_s is None
-                else min(warm_chunk_s, chunk_s)
-            )
-        rounds_run += int(chunk_temps.shape[0])
-        curves.append(cv)
-        over = deadline is not None and time.perf_counter() > deadline
-        if engine != "sweep" and ci + 1 < len(chunks) and not over:
-            # chain boundary reseed: each lane continues from its best
-            # shard winner with a fresh per-lane key stream
-            pa_np = np.asarray(fetch_global(pop_a))
-            pk_np = np.asarray(fetch_global(pop_k))
-            top = pk_np.argmax(axis=0)  # [L]
-            cur_seeds = np.stack(
-                [pa_np[top[i], i] for i in range(L)]
-            ).astype(np.int32)
-            cur_keys = jax.vmap(jax.random.split)(cur_keys)[:, 1]
-        if over:
-            timed_out = ci + 1 < len(chunks)
-            break
+        if _lsp is not None:
+            _lsp.set(rounds_run=rounds_run, timed_out=timed_out,
+                     scorer=scorer)
     t_solve = time.perf_counter()
 
     # per-lane final selection on the host: rank each lane's per-shard
@@ -1498,6 +1701,26 @@ def solve_tpu_batch(
         [np.asarray(fetch_global(c)) for c in curves], axis=2
     )  # [n_dev, L, rounds_run]
     wall = time.perf_counter() - t0
+    with _otrace.span("verify", lanes=L) as _vsp:
+        results = _select_lanes(
+            insts, pa, curve_np, n_dev, certify, wall, t_solve, t0,
+            platform, engine, L, chains_per_device, rounds, rounds_run,
+            timed_out, bkt_parts, bkt_rf, scorer, pallas_fallback,
+            time_limit_s, seed_moves,
+        )
+        if _vsp is not None:
+            _vsp.set(lanes_feasible=sum(
+                1 for r in results if r.stats["feasible"]))
+    return results
+
+
+def _select_lanes(
+    insts, pa, curve_np, n_dev, certify, wall, t_solve, t0, platform,
+    engine, L, chains_per_device, rounds, rounds_run, timed_out,
+    bkt_parts, bkt_rf, scorer, pallas_fallback, time_limit_s, seed_moves,
+) -> list[SolveResult]:
+    """Per-lane final selection + oracle verification (the batch path's
+    "verify" phase body)."""
     results = []
     for i, inst in enumerate(insts):
         best_a = None

@@ -1,0 +1,145 @@
+"""Prometheus text-format validator for ``serve.render_metrics`` —
+the guard for every future ``kao_*`` addition (ISSUE 3 satellite).
+
+Regex-level checks, per the Prometheus exposition format:
+
+- every comment line is a well-formed ``# HELP`` / ``# TYPE``;
+- every sample family has a HELP **and** TYPE pair (histogram
+  ``_bucket``/``_sum``/``_count`` samples resolve to their base
+  family);
+- metric and label names are legal; label values are quoted strings;
+- sample values parse as floats;
+- no duplicate samples (same name + same label set).
+"""
+
+import re
+
+from kafka_assignment_optimizer_tpu import serve as srv
+from kafka_assignment_optimizer_tpu.obs import trace as otrace
+
+_COMMENT = re.compile(
+    r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$"
+)
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(.*)\})?"
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?|\.[0-9]+)(?:[eE][-+]?[0-9]+)?"
+    r"|NaN|[+-]Inf)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def validate_prometheus(text: str):
+    """Parse ``text``; returns the set of (name, labels) samples seen.
+    Raises AssertionError with the offending line on any violation."""
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    samples: set = set()
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if line == "":
+            continue
+        if line.startswith("#"):
+            m = _COMMENT.match(line)
+            assert m, f"malformed comment line: {line!r}"
+            kind, name, rest = m.groups()
+            if kind == "TYPE":
+                assert rest in _TYPES, f"bad TYPE {rest!r}: {line!r}"
+                assert name not in types, f"duplicate TYPE for {name}"
+                types[name] = rest
+            else:
+                assert name not in helps, f"duplicate HELP for {name}"
+                helps[name] = rest
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels, value = m.groups()
+        float(value.replace("Inf", "inf"))  # parses
+        canon = ()
+        if labels:
+            pairs = _LABEL.findall(labels)
+            # the label regex must account for the whole labels blob
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in pairs)
+            assert rebuilt == labels, f"bad labels in: {line!r}"
+            assert len({k for k, _ in pairs}) == len(pairs), (
+                f"duplicate label name: {line!r}"
+            )
+            canon = tuple(sorted(pairs))
+        key = (name, canon)
+        assert key not in samples, f"duplicate sample: {line!r}"
+        samples.add(key)
+        # resolve histogram/summary series to their base family
+        base = name
+        for suf in _HISTO_SUFFIXES:
+            stem = name[: -len(suf)] if name.endswith(suf) else None
+            if stem and types.get(stem) in ("histogram", "summary"):
+                base = stem
+                break
+        assert base in types, f"sample without # TYPE: {line!r}"
+        assert base in helps, f"sample without # HELP: {line!r}"
+    return samples
+
+
+def test_render_metrics_is_valid_prometheus():
+    # move some counters + a batch + a phase observation first, so the
+    # labeled families and the histogram render non-empty
+    srv._count(requests_total=1)
+    srv._record_batch(3, 0.01, [
+        {"feasible": True, "replica_moves": 1, "objective_weight": 5},
+    ])
+    otrace.observe_phase("ladder", 0.2)
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    assert "kao_requests_total" in names
+    assert "kao_solves_total" in names
+    assert ("kao_batch_size_total", (("size", "3"),)) in samples
+    assert "kao_phase_seconds_bucket" in names
+    assert "kao_phase_seconds_sum" in names
+    assert "kao_phase_seconds_count" in names
+
+
+def test_phase_histogram_is_cumulative_with_inf_terminal():
+    otrace.observe_phase("_fmt_probe", 0.001)
+    otrace.observe_phase("_fmt_probe", 999.0)  # beyond the last bucket
+    text = srv.render_metrics()
+    rows = {}
+    for line in text.splitlines():
+        m = _SAMPLE.match(line)
+        if not m or m.group(1) != "kao_phase_seconds_bucket":
+            continue
+        labels = dict(_LABEL.findall(m.group(2)))
+        if labels.get("phase") == "_fmt_probe":
+            rows[labels["le"]] = float(m.group(3))
+    count = next(
+        float(_SAMPLE.match(ln).group(3))
+        for ln in text.splitlines()
+        if ln.startswith('kao_phase_seconds_count{phase="_fmt_probe"}')
+    )
+    les = [le for le in rows if le != "+Inf"]
+    # cumulative: monotone non-decreasing in le, +Inf equals count
+    ordered = sorted(les, key=float)
+    vals = [rows[le] for le in ordered]
+    assert vals == sorted(vals)
+    assert rows["+Inf"] == count == 2.0
+    # the 999 s observation only appears in the +Inf bucket
+    assert vals[-1] == 1.0
+
+
+def test_validator_rejects_malformed_exposition():
+    import pytest
+
+    for bad in (
+        "kao_x 1\n",                                  # no HELP/TYPE
+        "# TYPE kao_y counter\nkao_y 1\n",            # no HELP
+        "# HELP kao_z z\n# TYPE kao_z counter\nkao_z one\n",  # bad value
+        "# HELP kao_w w\n# TYPE kao_w counter\n"
+        "kao_w 1\nkao_w 2\n",                         # duplicate sample
+        "# HELP kao_v v\n# TYPE kao_v wrongtype\nkao_v 1\n",
+        '# HELP kao_u u\n# TYPE kao_u counter\n'
+        'kao_u{9bad="x"} 1\n',                        # bad label name
+    ):
+        with pytest.raises(AssertionError):
+            validate_prometheus(bad)

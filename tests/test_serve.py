@@ -563,3 +563,137 @@ def test_healthz_reports_coalescing_config(server_url):
     co = body["coalescing"]
     assert set(co) == {"enabled", "window_ms", "max_batch"}
     assert co["max_batch"] >= 1
+
+
+# --------------------------------------------------------------------------
+# solve-trace telemetry (ISSUE 3: trace IDs + /debug/solves)
+# --------------------------------------------------------------------------
+
+
+def _span_names(span_dict, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(span_dict["name"])
+    for c in span_dict.get("spans", []):
+        _span_names(c, acc)
+    return acc
+
+
+def test_submit_echoes_trace_id_and_debug_endpoint(server_url):
+    """Acceptance (ISSUE 3): the solve response echoes a request-scoped
+    trace_id, and the same solve report — phase spans included — is
+    retrievable from the running server via GET /debug/solves/<id>."""
+    status, body = post(server_url, _tpu_payload("tr."))
+    assert status == 200, body
+    tid = body.get("trace_id")
+    assert tid, body
+    assert body["report"].get("solver_trace_id") == tid
+    with urllib.request.urlopen(
+        server_url + f"/debug/solves/{tid}", timeout=30
+    ) as r:
+        rep = json.loads(r.read())
+    assert rep["trace_id"] == tid
+    names = set(_span_names(rep["spans"]))
+    assert {"bounds", "constructor", "seed", "ladder", "polish",
+            "verify"} <= names, names
+    assert rep["wall_s"] > 0 and rep["phases"]
+    # the listing surfaces it, newest first
+    with urllib.request.urlopen(
+        server_url + "/debug/solves", timeout=30
+    ) as r:
+        ids = json.loads(r.read())["trace_ids"]
+    assert tid in ids
+    # unknown IDs are a structured 404
+    try:
+        urllib.request.urlopen(
+            server_url + "/debug/solves/nosuchtrace", timeout=30
+        )
+        status = 200
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 404
+
+
+def test_submit_non_tpu_solver_also_traced(server_url):
+    """Request traces are solver-agnostic: a milp solve still gets a
+    trace_id and a retrievable (engine-phase-free) report."""
+    status, body = post(server_url, {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    })
+    assert status == 200, body
+    tid = body.get("trace_id")
+    assert tid
+    with urllib.request.urlopen(
+        server_url + f"/debug/solves/{tid}", timeout=30
+    ) as r:
+        rep = json.loads(r.read())
+    assert rep["spans"]["attrs"]["solver"] == "milp"
+
+
+def test_submit_no_trace_when_disabled(monkeypatch):
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    monkeypatch.setitem(srv_mod.OBS, "trace", False)
+    out = handle_submit({
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    })
+    assert "trace_id" not in out
+    assert "solver_trace_id" not in out["report"]
+
+
+def test_coalesced_batch_shares_one_trace(monkeypatch):
+    """Every member of a coalesced dispatch echoes the SAME trace_id,
+    and that ID retrieves the batch's solve report."""
+    from kafka_assignment_optimizer_tpu import serve as srv_mod
+
+    monkeypatch.setattr(srv_mod._Coalescer, "should_bypass",
+                        lambda self, key: False)
+    monkeypatch.setattr(srv_mod._COALESCER, "window_s", 0.25)
+    monkeypatch.setattr(srv_mod._COALESCER, "max_batch", 4)
+    results: list = [None, None]
+
+    def run(i):
+        payload = _tpu_payload()
+        payload["options"] = dict(payload["options"], seed=i)
+        results[i] = handle_submit(payload, lock_wait_s=30.0)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    tids = {out.get("trace_id") for out in results}
+    assert len(tids) == 1 and None not in tids
+    from kafka_assignment_optimizer_tpu.obs import trace as otrace
+
+    rep = otrace.RECENT.get(tids.pop())
+    assert rep is not None and rep["name"] == "request_batch"
+    names = set(_span_names(rep["spans"]))
+    assert {"seed", "ladder", "verify"} <= names, names
+
+
+def test_healthz_observability_section(server_url):
+    with urllib.request.urlopen(server_url + "/healthz", timeout=30) as r:
+        body = json.loads(r.read())
+    obs = body["observability"]
+    assert obs["trace_enabled"] is True
+    assert obs["report_ring_capacity"] >= 1
+    assert obs["solve_reports_held"] >= 0
+
+
+def test_metrics_phase_histogram_renders(server_url):
+    """After a traced solve, /metrics carries the per-phase latency
+    histogram family with HELP/TYPE pairs."""
+    status, _ = post(server_url, _tpu_payload())
+    assert status == 200
+    with urllib.request.urlopen(server_url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "# TYPE kao_phase_seconds histogram" in text
+    assert 'kao_phase_seconds_bucket{phase="ladder"' in text or (
+        'kao_phase_seconds_bucket{phase="constructor"' in text
+    )
+    assert "# HELP kao_requests_total" in text
