@@ -265,6 +265,25 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
             "cache_hit": c1["compiles_total"] == c0["compiles_total"],
             "feasible": res_v.report()["feasible"],
         }
+    # pipeline A/B (adversarial search rows, warm only): the same solve
+    # with the double-buffered ladder dispatch disabled — identical
+    # executables (pipelining is host orchestration, so the cache stays
+    # warm), best of 2 against the pipelined best-warm. >= 1.0 means
+    # the overlap is paying for itself in wall-clock; the per-chunk
+    # overlap evidence lives in the solve report's boundary_overlap_s
+    # span fields either way (docs/PIPELINE.md).
+    pipeline_speedup = None
+    if warm and knobs:
+        nopipe = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            # trace=True matches the pipelined baseline runs above —
+            # the A/B must isolate the dispatcher, not tracing overhead
+            optimize(solver="tpu", seed=seed, trace=True, pipeline=False,
+                     **knobs, **sc.kwargs)
+            nopipe.append(time.perf_counter() - t0)
+        if min(walls[1:]) > 0:
+            pipeline_speedup = round(min(nopipe) / min(walls[1:]), 3)
     default_wall = default_proved = None
     if knobs:
         t0 = time.perf_counter()
@@ -307,6 +326,9 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         # telemetry): localizes a wall-clock regression to bounds /
         # constructor / seed / ladder / polish / verify
         "phase_s": phase_s,
+        # pipeline-on/off A/B on the warm search rows (null elsewhere)
+        "pipeline_speedup": pipeline_speedup,
+        "pipeline": res.solve.stats.get("pipeline"),
         **({"bucket_reuse": bucket_reuse} if bucket_reuse else {}),
         "moves": report["replica_moves"],
         "min_moves_lb": sc.min_moves_lb,
@@ -471,7 +493,8 @@ STDOUT_BUDGET = 1600
 ROW_SCHEMA = ("scenario,warm_s,cold_s,moves,min_moves_lb,feasible,"
               "proved_optimal,constructed,engine,path,compile_s,"
               "cache_compiles,cache_hits,"
-              "phase_s[bounds,constructor,seed,ladder,polish,verify]")
+              "phase_s[bounds,constructor,seed,ladder,polish,verify],"
+              "pipeline_speedup")
 
 
 def _compact_row(r: dict | None, name: str, err: str | None) -> list:
@@ -479,7 +502,7 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
     every README results-table row from the artifact alone."""
     if r is None:
         return [name, None, None, None, None, 0, 0, 0, "error",
-                (err or "failed")[:80], None, None, None, None]
+                (err or "failed")[:80], None, None, None, None, None]
     cache = r.get("cache") or {}
     ph = r.get("phase_s") or {}
     return [
@@ -498,6 +521,10 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
         cache.get("exec_hits"),
         # positional phase seconds (PHASE_ORDER); null = phase untimed
         [ph.get(p) for p in PHASE_ORDER] if ph else None,
+        # pipeline-on/off A/B (warm search rows only): no-pipeline
+        # best-warm / pipelined best-warm — >= 1.0 means the overlap
+        # pays for itself in wall-clock
+        r.get("pipeline_speedup"),
     ]
 
 

@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--time-limit", type=float, help="solver time limit seconds")
     ap.add_argument(
+        "--no-pipeline",
+        action="store_true",
+        help="disable the double-buffered ladder dispatch (tpu solver; "
+        "docs/PIPELINE.md): chunks then run strictly one at a time, "
+        "with all boundary work on the critical path — the A/B and "
+        "debugging escape hatch; results are bit-identical either way",
+    )
+    ap.add_argument(
         "--checkpoint",
         metavar="PATH",
         help="warm-start from / save the best plan to this .npz (tpu solver); "
@@ -209,6 +217,8 @@ def _run(args: argparse.Namespace) -> int:
         kw["trace"] = True
     if args.time_limit:
         kw["time_limit_s"] = args.time_limit
+    if args.no_pipeline:
+        kw["pipeline"] = False
 
     res = optimize(
         current,

@@ -292,7 +292,6 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
 
         def ladder_times(n_sweeps: int, reps: int = 5) -> list[float]:
             temps = geometric_temps(2.0, 0.02, n_sweeps)
-            state = init_sweep_state(m, a0, key, mesh, 8)
 
             def run(st):
                 _st, pa, _pk, _c = solve_on_mesh(
@@ -304,9 +303,14 @@ def kernel_vs_xla(smoke: bool = False, n: int = N_CANDIDATES) -> dict:
                 # tunneled-TPU client (no-op returns in ~0.1 ms)
                 return _np.asarray(jax.device_get(pa)).sum()
 
-            run(state)  # warmup/compile
+            # the sweep solver DONATES its state (parallel.mesh): each
+            # run consumes the buffers it is handed, so every repeat
+            # gets a fresh identical state (device_put of host views —
+            # microseconds, outside the timed region)
+            run(init_sweep_state(m, a0, key, mesh, 8))  # warmup/compile
             times = []
             for _ in range(reps):
+                state = init_sweep_state(m, a0, key, mesh, 8)
                 t0 = time.perf_counter()
                 run(state)
                 times.append(time.perf_counter() - t0)

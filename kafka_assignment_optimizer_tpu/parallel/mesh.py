@@ -115,6 +115,22 @@ def _lower_and_compile(fn, args):
     return fn.lower(*args).compile()
 
 
+def _args_alive(args) -> bool:
+    """False when any array in ``args`` was already consumed by a
+    donating dispatch: the jit fallback below would only raise a
+    confusing "buffer deleted" error on top of the real one, so the
+    original exception should propagate instead."""
+    for x in jax.tree_util.tree_leaves(args):
+        deleted = getattr(x, "is_deleted", None)
+        if callable(deleted):
+            try:
+                if deleted():
+                    return False
+            except Exception:
+                continue
+    return True
+
+
 # in-flight compile dedup: (solver, shapes) keys whose first caller is
 # still inside _lower_and_compile. The serve worker pool runs cold
 # same-bucket requests CONCURRENTLY, and without this gate each of them
@@ -150,6 +166,10 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             except Exception:
                 with _EXECUTABLES_LOCK:
                     _EXECUTABLES.pop(key, None)
+                if not _args_alive(args):
+                    # a donating executable consumed its buffers before
+                    # failing — the jit retry cannot run on dead args
+                    raise
                 _CACHE_STATS.record_exec(False, fallback=True)
                 with _otrace.span("dispatch", cache="fallback"):
                     return fn(*args)
@@ -171,6 +191,8 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             with _otrace.span("dispatch", cache="miss"):
                 out = ex(*args)
         except Exception:
+            if not _args_alive(args):
+                raise
             _CACHE_STATS.record_exec(False, fallback=True)
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
@@ -250,13 +272,24 @@ def _compiled_solver(
         # bench run died here while every CPU test passed, because
         # the Pallas scorer route is TPU-only). The out_specs above
         # are explicit, so the check adds nothing we rely on.
+        #
+        # Sweep engine: the carried state (populations + per-chain best
+        # snapshots + RNG keys) is DONATED — every state leaf has an
+        # identically shaped/dtyped/sharded output leaf, so XLA updates
+        # the chain populations in HBM in place instead of reallocating
+        # the full [n_dev, N, P, R] arrays every chunk. The donation
+        # invariant (a state is consumed by exactly one dispatch and
+        # never touched again — the engine commits the RETURNED state)
+        # is enforced by the runtime even on CPU: reuse raises, which
+        # is what tests/test_donation_smoke.py pins for CI.
         fn = jax.jit(
             _shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-            )
+            ),
+            donate_argnums=(1,) if engine == "sweep" else (),
         )
         with _COMPILED_LOCK:
             # a concurrent builder of the same key may have landed
@@ -325,13 +358,18 @@ def _compiled_lane_solver(
             in_specs = (P(), P(), P(AXIS), P())
             out_specs = (P(AXIS), P(AXIS), P(AXIS))
 
+        # lane state is donated exactly like the single-instance sweep
+        # state (same leaf-for-leaf in/out correspondence, with a lane
+        # axis after the device axis) — a batched chunk updates all L
+        # lanes' populations in place
         fn = jax.jit(
             _shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-            )
+            ),
+            donate_argnums=(1,) if engine == "sweep" else (),
         )
         with _COMPILED_LOCK:
             fn = _COMPILED.setdefault(cache_key, fn)
@@ -363,18 +401,20 @@ def init_lane_state(
     L, n_parts, n_slots = lane_seeds.shape
     k0, mv0 = _lane_seed_rank_fn()(jnp.asarray(lane_seeds), m_stack)
     k0, mv0 = np.asarray(k0), np.asarray(mv0)  # [L]
-    tile_a = np.broadcast_to(
+    tile = np.broadcast_to(
         lane_seeds[None, :, None], (n_dev, L, n, n_parts, n_slots)
     )
     # per-(device, lane) keys: each lane splits ITS key over the device
     # axis, exactly as the single-instance path splits its one key —
-    # [L, n_dev, 2] -> [n_dev, L, 2]
+    # [L, n_dev, 2] -> [n_dev, L, 2]. The population/snapshot leaves
+    # are independent materialized buffers for the same reason as
+    # init_sweep_state: the lane solver donates this state.
     dev_keys = jax.vmap(lambda k: jax.random.split(k, n_dev))(keys)
     state = (
-        tile_a,
+        np.array(tile),
         np.broadcast_to(k0[None, :, None], (n_dev, L, n)).astype(k0.dtype),
         np.broadcast_to(mv0[None, :, None], (n_dev, L, n)).astype(np.int32),
-        tile_a,
+        np.array(tile),
         jnp.transpose(dev_keys, (1, 0, 2)),
     )
     sh = jax.sharding.NamedSharding(mesh, P(AXIS))
@@ -476,14 +516,20 @@ def init_sweep_state(
     # host-side numpy tiling: the eager jnp broadcast/full ops each
     # compile a tiny executable, and over a tunneled TPU every compile
     # costs a ~0.5 s remote round-trip (r5 cold-start profile); numpy
-    # views cost nothing and device_put ships them without compiling
+    # tiles cost ~nothing and device_put ships them without compiling.
+    # The current-population and best-snapshot leaves are materialized
+    # as two INDEPENDENT buffers (not two views of one broadcast):
+    # device_put may zero-copy a contiguous-compatible host view, and
+    # with the solver donating the state (in-place chunk updates —
+    # docs/PIPELINE.md), two leaves silently sharing one buffer would
+    # corrupt each other.
     a_np = np.asarray(a)
-    tile_a = np.broadcast_to(a_np, (n_dev, n, n_parts, n_slots))
+    tile = np.broadcast_to(a_np, (n_dev, n, n_parts, n_slots))
     state = (
-        tile_a,
+        np.array(tile),
         np.full((n_dev, n), np.asarray(k0), np.asarray(k0).dtype),
         np.full((n_dev, n), np.asarray(mv0), np.int32),
-        tile_a,
+        np.array(tile),
         jax.random.split(key, n_dev),
     )
     sh = jax.sharding.NamedSharding(mesh, P(AXIS))
@@ -574,6 +620,48 @@ def fetch_global(x):
         return jax.device_get(
             multihost_utils.process_allgather(x, tiled=True)
         )
+
+
+class _AsyncFetch:
+    """Handle on an in-flight device→host transfer started by
+    :func:`fetch_global_async`: the DMA begins at construction (single
+    process; multi-controller allgathers cannot start early and stay in
+    the blocking ``get``), and ``get()`` materializes the host value —
+    idempotently, so trace instrumentation may consume it at a chunk
+    boundary while the ladder exit still sees the same array."""
+
+    __slots__ = ("_x", "_val", "_done")
+
+    def __init__(self, x):
+        self._x = x
+        self._val = None
+        self._done = False
+        if jax.process_count() == 1:
+            for leaf in jax.tree_util.tree_leaves(x):
+                start = getattr(leaf, "copy_to_host_async", None)
+                if callable(start):
+                    try:
+                        start()
+                    except Exception:
+                        # the copy is an optimization only — get()
+                        # falls back to the ordinary blocking transfer
+                        pass
+
+    def get(self):
+        if not self._done:
+            self._val = fetch_global(self._x)
+            self._x = None  # release the device reference
+            self._done = True
+        return self._val
+
+
+def fetch_global_async(x):
+    """Start the device→host copy of ``x`` without blocking (the engine
+    moves per-chunk curve transfers off the critical path this way: the
+    copy overlaps the next chunk's device execution — or, synchronous
+    mode, the boundary's host work — and ``.get()`` at the next boundary
+    or at ladder exit finds it already resident)."""
+    return _AsyncFetch(x)
 
 
 def best_of(best_a, best_k, curve=None):

@@ -128,7 +128,7 @@ MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
 # client-chosen paths. Operators who want checkpointing use the CLI.
 ALLOWED_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
-    "time_limit_s", "t_hi", "t_lo", "n_devices",
+    "time_limit_s", "t_hi", "t_lo", "n_devices", "pipeline",
 })
 
 # saturation policy: how long a request waits for a queue slot before
@@ -150,7 +150,7 @@ DEFAULT_MAX_BATCH = 8
 # other knob (e.g. steps_per_round) takes the single-solve path
 _BATCHABLE_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "engine", "time_limit_s",
-    "t_hi", "t_lo", "n_devices",
+    "t_hi", "t_lo", "n_devices", "pipeline",
 })
 # executable-accumulation hygiene: drop in-process jit caches after this
 # many completed solves (see _SolveQueue._maintenance)
@@ -794,6 +794,10 @@ def handle_submit(
         or not limit > 0
     ):
         raise ApiError(400, "'time_limit_s' must be a positive number")
+    if "pipeline" in options and not isinstance(
+        options["pipeline"], bool
+    ):
+        raise ApiError(400, "'pipeline' must be a boolean")
     if max_solve_s is not None:
         # cap every solve: client may tighten the limit but not exceed it
         options["time_limit_s"] = (
@@ -1353,6 +1357,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="persistent XLA compile-cache directory "
                          "(sets KAO_JIT_CACHE, so warmth survives "
                          "process restarts)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="disable the double-buffered ladder dispatch "
+                         "for every solve this service runs "
+                         "(docs/PIPELINE.md); clients may still opt a "
+                         "request back in with options.pipeline=true")
     ap.add_argument("--no-trace", action="store_true",
                     help="disable per-request solve traces (responses "
                          "then carry no trace_id and /debug/solves "
@@ -1394,6 +1403,10 @@ def main(argv: list[str] | None = None) -> int:
     from .utils.platform import pin_platform
 
     pin_platform()
+    if args.no_pipeline:
+        from .solvers.tpu.engine import set_pipeline_default
+
+        set_pipeline_default(False)
     OBS["trace"] = not args.no_trace
     OBS["profile_dir"] = args.profile_dir
     OBS["profile_solves"] = args.profile_solves

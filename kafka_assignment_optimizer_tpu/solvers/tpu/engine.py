@@ -15,7 +15,10 @@ North-star target (BASELINE.json): plan quality <= lp_solve's move count,
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import jax
@@ -65,6 +68,80 @@ _RESEAT_WAIT_MID_MEMBERS = 20_000
 # budget knobs opt out — a caller tuning the search wants the search.
 _EXACT_RACE_PARTS = 64
 _EXACT_RACE_VARS = 20_000  # 2 * brokers * partitions, the MILP var count
+
+# pipelined ladder dispatch (docs/PIPELINE.md): dispatch chunk i+1
+# before retiring chunk i, so the host boundary work — curve transfer,
+# best-tracking, certificates, checkpointing — overlaps the next
+# chunk's device execution instead of leaving the accelerator idle.
+# PRNG keys are split in deterministic order up front and the sweep
+# state carries its own RNG, so speculation never changes a trajectory.
+# Opt out per solve (pipeline=False / --no-pipeline) or process-wide
+# via KAO_NO_PIPELINE=1 for A/B runs and debugging. Falsy spellings
+# ("0"/"off"/"false"/"none") leave the pipeline ON — same convention
+# as KAO_BUCKETS (solvers.tpu.bucket).
+_PIPELINE_DEFAULT = os.environ.get("KAO_NO_PIPELINE", "").lower() in (
+    "", "off", "0", "none", "false",
+)
+
+
+def _leaves_alive(tree) -> bool:
+    """False when any array in ``tree`` was consumed by a donating
+    dispatch. The Pallas→XLA retry must not re-dispatch a consumed
+    state: a Mosaic error raised at EXECUTION time (after donation)
+    leaves nothing to retry on, and the real error should surface
+    instead of a confusing "buffer deleted" from the retry. Delegates
+    to the mesh layer's donation-liveness predicate (lazily — the
+    constructed fast path never imports device-adjacent modules)."""
+    if tree is None:
+        return True
+    from ...parallel.mesh import _args_alive
+
+    return _args_alive(tree)
+
+
+def set_pipeline_default(enabled: bool) -> None:
+    """Process-wide default for solves that do not pass ``pipeline=``
+    explicitly (serve's ``--no-pipeline`` flag lands here)."""
+    global _PIPELINE_DEFAULT
+    _PIPELINE_DEFAULT = bool(enabled)
+
+
+class _WarmChunkRegistry:
+    """Cross-solve warm per-chunk duration estimates, keyed by the
+    executable identity a chunk actually dispatches — (path tag, mesh
+    size, chains, budget knobs, bucket shape, chunk length, scorer).
+    The batched lane path tags its keys with ``("lanes", L, ...)`` and
+    the sequential path with ``("single", ...)``, so a slow first
+    batched chunk (L lanes of device work per dispatch) can never
+    inflate the sequential path's deadline estimate — and vice versa.
+    Values are REPLACED per solve (the latest solve's own warm minimum),
+    so a one-off slow solve does not poison the estimate forever."""
+
+    def __init__(self, capacity: int = 64):
+        self._cap = capacity
+        self._lock = threading.Lock()
+        self._d: OrderedDict[tuple, float] = OrderedDict()
+
+    def get(self, key: tuple) -> float | None:
+        with self._lock:
+            v = self._d.get(key)
+            if v is not None:
+                self._d.move_to_end(key)
+            return v
+
+    def update(self, key: tuple, seconds: float) -> None:
+        with self._lock:
+            self._d.pop(key, None)
+            self._d[key] = float(seconds)
+            while len(self._d) > self._cap:
+                self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+
+_WARM_CHUNKS = _WarmChunkRegistry()
 
 # the greedy+reseat racer (r4): on slack-caps instances the greedy seed
 # already keeps every keepable member, so the exact leader reseat alone
@@ -163,9 +240,13 @@ def _solve_tpu(
     time_limit_s: float | None = None,
     cert_min_savings_s: float = 1.0,
     precompile: bool = False,
+    pipeline: bool | None = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
+    # double-buffered ladder dispatch (docs/PIPELINE.md): None defers
+    # to the process default (--no-pipeline / KAO_NO_PIPELINE flip it)
+    pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
     from ...utils.platform import enable_compile_cache, ensure_backend
 
     # a previous solve on this instance may have cancelled straggling
@@ -292,7 +373,7 @@ def _solve_tpu(
         inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
         n_devices, engine, checkpoint, profile_dir, time_limit_s,
         backend_fut, t0, bounds_fut,
-        cert_min_savings_s, lp_fut, multi, lp_wait_s,
+        cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
     )
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
@@ -331,6 +412,7 @@ def _solve_tpu(
                 checkpoint=checkpoint, profile_dir=profile_dir,
                 time_limit_s=remaining,
                 cert_min_savings_s=cert_min_savings_s,
+                pipeline=pipeline,
             )
         def rank(r):
             return (
@@ -585,13 +667,17 @@ class _LadderResult:
     scorer: str = "xla"
     pallas_fallback: str | None = None
     tight_fut: object = None    # in-flight tier-1 LP, reused at the end
+    pipelined: bool = False     # speculative double-buffered dispatch ran
+    dispatch_s: float = 0.0     # host time enqueueing chunks (incl. compile)
+    device_s: float = 0.0       # host time blocked on device results
+    boundary_overlap_s: float = 0.0  # boundary work hidden behind device chunks
 
 
 def _run_ladder(
     inst, m, mesh, chains_per_device, rounds, steps_per_round, engine,
     scorer, chunks, seed_dev, key, sweep_state, lp_fut, bounds_fut,
     multi, cert_min_savings_s, t0, time_limit_s, profile_dir,
-    polish_starter=None,
+    polish_starter=None, pipeline=True, warm_key=(),
 ) -> _LadderResult:
     """Stage 4 — the chunked annealing ladder: dispatch each schedule
     chunk to the mesh, then do the boundary work between chunks — adopt
@@ -600,200 +686,392 @@ def _run_ladder(
     costs more than certification itself; non-blocking on the bounds
     prefetch — annealing continues while the LPs compute), reseed the
     chain engine from the global best, and honor the wall-clock
-    deadline. A Mosaic lowering failure on the first dispatch retries
-    the chunk on the XLA scorer and records the fallback; anything else
-    surfaces with its real traceback."""
-    from ...parallel.mesh import fetch_global, solve_on_mesh
+    deadline.
+
+    Sweep engine, ``pipeline=True`` (the default): the ladder runs
+    DOUBLE-BUFFERED — chunk i+1 is dispatched before chunk i is
+    retired, so all of chunk i's boundary work executes while chunk i+1
+    runs on device (docs/PIPELINE.md). PRNG keys are pre-split in
+    deterministic order (and the sweep state carries its own RNG), so
+    the speculative dispatch consumes no host decision and pipelined
+    trajectories are bit-identical to synchronous ones. The deadline
+    then decides whether to RETIRE the in-flight chunk, not whether to
+    dispatch it — abandoning it wastes only speculative device work.
+
+    A Mosaic lowering failure retries the chunk on the XLA scorer and
+    records the fallback (pipelined mode drains first: the failed
+    speculation is retired synchronously after the current chunk's
+    boundary, then the pipeline re-enters); anything else surfaces with
+    its real traceback."""
+    from ...parallel.mesh import (
+        fetch_global, fetch_global_async, solve_on_mesh,
+    )
 
     r = _LadderResult(scorer=scorer)
+    n = len(chunks)
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
+    deadline = None if time_limit_s is None else t0 + time_limit_s
+    # chunk 0's duration is compile-inclusive and a fallback chunk's
+    # includes the XLA retry's first compile — both wildly overstate a
+    # warm chunk, so neither may feed the warm estimate (a cold solve
+    # with budget left would otherwise stop after one chunk). The
+    # cross-solve prior for this exact executable identity covers the
+    # gap: a warm re-solve can gate from chunk 1 instead of flying
+    # blind until two of its own chunks have retired.
+    warm_chunk_s: float | None = None
+    last_chunk_s: float | None = None
+    chunk_len = int(chunks[0].shape[0]) if n else 0
+
+    def _wkey() -> tuple:
+        return (*warm_key, chunk_len, r.scorer)
+
+    prior_s = _WARM_CHUNKS.get(_wkey())
+    handles: list = []  # per-retired-chunk async curve transfers
+
+    # PRNG keys split up front, in exactly the order the sequential
+    # loop used to split them — a speculatively dispatched chunk must
+    # consume no host-side decision. (The sweep engine ignores these:
+    # its RNG rides in the carried state.)
+    if n == 1:
+        subs = [key]  # bit-identical to the unchunked solve
+    else:
+        subs, _k = [], key
+        for _ in range(n):
+            _k, _s = jax.random.split(_k)
+            subs.append(_s)
+
+    def dispatch(i, st):
+        """Enqueue chunk i on the device; returns without waiting for
+        the result (past any compile). Timed internally so a retry
+        after a Pallas fallback times the successful dispatch only."""
+        td = time.perf_counter()
+        out = solve_on_mesh(
+            m, seed_dev, subs[i], mesh, chains_per_device, rounds,
+            steps_per_round, engine=engine, temps=chunks[i],
+            scorer=r.scorer, state=st,
+        )
+        if engine == "sweep":
+            new_state, pop_a, pop_k, curve = out
+        else:
+            new_state, (pop_a, pop_k, curve) = None, out
+        return new_state, pop_a, pop_k, curve, time.perf_counter() - td
+
+    def _is_lowering(e: Exception) -> bool:
+        # only a Mosaic/Pallas lowering failure warrants the XLA retry;
+        # anything else (OOM, sharding bug, regression) must surface
+        # with its real traceback
+        msg = f"{type(e).__name__}: {e}"
+        return r.scorer == "pallas" and any(
+            s in msg for s in ("Mosaic", "mosaic", "pallas", "Pallas",
+                               "lowering", "Lowering")
+        )
+
+    def _note_fallback(i, e) -> None:
+        nonlocal warm_chunk_s, prior_s
+        r.pallas_fallback = repr(e)[:500]
+        r.scorer = "xla"
+        # scorer-pure estimates: Pallas chunks are materially faster
+        # than XLA chunks, so measurements from before the fallback
+        # must not gate (or be filed for) the XLA executable — restart
+        # the warm measurement and re-fetch the prior under the new key
+        warm_chunk_s = None
+        prior_s = _WARM_CHUNKS.get(_wkey())
+        _olog.warn("pallas_fallback", chunk=i, error=repr(e)[:200])
+
+    def dispatch_or_fallback(i, st):
+        """Dispatch with the Mosaic→XLA retry. Only legal with the
+        pipeline EMPTY: the retry recompiles synchronously. Safe on the
+        carried state when the failure is a true lowering error — those
+        raise at trace/compile time, before any buffer (donated
+        included) is consumed; a Mosaic-worded error raised at
+        EXECUTION time has already consumed the donated state, so it
+        re-raises instead of retrying on dead buffers. Returns
+        ``(dispatch tuple, fell_back)``."""
+        try:
+            return dispatch(i, st), False
+        except Exception as e:
+            if not _is_lowering(e) or not _leaves_alive(st):
+                raise
+            _note_fallback(i, e)
+            return dispatch(i, st), True
+
+    def chunk_attrs(sp, i, dispatch_s, device_s, overlap_s, h,
+                    scorer_ran) -> None:
+        """Per-chunk annealing stats: the best-score curve is the exact
+        record the device already returns, so accepts/declines are
+        measured at best-curve granularity (rounds that did / did not
+        improve the global best) — no extra device outputs, trajectory
+        bit-parity untouched. Consuming the async curve handle here is
+        free: the copy was started at retire time. ``scorer_ran`` is
+        the scorer this chunk actually executed under — a speculative
+        dispatch failing mid-boundary flips ``r.scorer`` before the
+        current chunk's attrs are recorded."""
+        if sp is None:
+            return
+        t_np = np.asarray(chunks[i])
+        best = np.asarray(h.get()).max(axis=0)
+        imp = int((np.diff(best) > 0).sum()) if best.size > 1 else 0
+        sp.set(
+            rounds=int(t_np.shape[0]),
+            t_hi=float(t_np[0]),
+            t_lo=float(t_np[-1]),
+            scorer=scorer_ran,
+            dispatch_s=round(dispatch_s, 4),
+            device_s=round(device_s, 4),
+            boundary_overlap_s=round(overlap_s, 4),
+            energy_before=int(best[0]) if best.size else None,
+            energy_after=int(best[-1]) if best.size else None,
+            accepts=imp,
+            declines=max(0, int(best.size) - 1 - imp),
+        )
+
+    def boundary(i) -> bool:
+        """Between-chunk host work for retired chunk i: constructor
+        adoption, the boundary optimality certificate, the chain
+        engine's reseed. Returns True when the ladder should stop (a
+        certified plan exists). Under the pipelined dispatcher this
+        whole block overlaps chunk i+1's device execution."""
+        nonlocal seed_dev, reseat_tries
+        if i + 1 >= n:
+            return False
+        # a finished constructor worker short-circuits the rest of the
+        # ladder with its certified plan
+        if lp_fut is not None and lp_fut.done():
+            try:
+                plan, ok, _extends = lp_fut.result()
+            except Exception:
+                plan, ok = None, False
+            if ok:
+                r.certified_a = np.asarray(plan, dtype=np.int32)
+                r.constructed = True
+                return True
+        # boundary certificate: if any per-shard winner provably hits
+        # the optimum, the remaining chunks cannot improve it. (The
+        # sweep engine's populations continue on-device via sweep_state
+        # and need no boundary host data until a check actually runs —
+        # it skips even the device_get; the chain engine always needs
+        # it for the reseed.)
+        est_chunk_s = (
+            warm_chunk_s if warm_chunk_s is not None
+            else (prior_s if prior_s is not None else last_chunk_s)
+        )
+        remaining_s = (n - i - 1) * (est_chunk_s or 0.0)
+        do_cert = (
+            not multi
+            and remaining_s > cert_min_savings_s
+            and bounds_fut.done()
+        )
+        if engine != "sweep" or do_cert:
+            pa, pk = (
+                np.asarray(x)
+                for x in fetch_global((r.pop_a, r.pop_k))
+            )
+            # test ONLY the top-ranked shard winner: the key ranks by
+            # weight, so a lower-ranked candidate cannot pass a weight
+            # bound the top one failed, and repeating the reseat LP per
+            # shard per boundary would cost seconds for no new outcome
+            for j in np.argsort(-pk)[:1] if do_cert else []:
+                # bucket-padded rows are sliced off before any
+                # host-side oracle sees the candidate
+                cand = arrays.unpad_candidate(pa[j], inst)
+                mc = inst.move_count(cand)
+                if not inst.is_feasible(cand):
+                    continue
+                lb_exact, ub0 = bounds_fut.result()
+                if mc <= lb_exact:
+                    w_cand = inst.preservation_weight(cand)
+                    if w_cand < ub0 and reseat_tries < 3:
+                        # below the bound: a leader reseat can lift it.
+                        # The negative-cycle canceller handles a
+                        # near-optimal candidate in well under a second
+                        # even at 150k slots (r4), so every size gets
+                        # at most 3 boundary tries — the final
+                        # certification reseats once regardless
+                        reseat_tries += 1
+                        cand = inst.best_leader_assignment(cand)
+                        w_cand = inst.preservation_weight(cand)
+                    if w_cand >= ub0:
+                        r.certified_a = cand
+                        break
+                    # tier 0 failed: evaluate the tight tier-1 LP on a
+                    # worker thread — several seconds at 10k
+                    # partitions; the devices keep annealing meanwhile
+                    if r.tight_fut is None:
+                        r.tight_fut = _BoundsTask(
+                            lambda: inst.weight_upper_bound(tight=True)
+                        )
+                    elif r.tight_fut.done() and (
+                        w_cand >= r.tight_fut.result()
+                    ):
+                        r.certified_a = cand
+                        break
+            if r.certified_a is not None:
+                return True
+            if do_cert and polish_starter is not None:
+                # a certificate check ran and did NOT certify: first
+                # evidence this instance may need the steepest-descent
+                # polish — start its AOT compile now so it overlaps the
+                # remaining chunks. Deferred until here (r5) because
+                # the certify-first design means most at-scale solves
+                # never polish, and on few-core hosts an eager compile
+                # thread STEALS the cpu the main compile needs
+                # (measured: the two ~20 s compiles serialize and
+                # double the cold start).
+                polish_starter()
+            if engine != "sweep":
+                seed_dev = jnp.asarray(pa[int(np.argmax(pk))])
+        return False
+
+    def retire_common(i, pop_a, pop_k, curve, disp_s, device_s,
+                      chunk_s, fell_back):
+        """Bookkeeping shared by both loop shapes, after chunk i's
+        results are on device and synced."""
+        nonlocal warm_chunk_s, last_chunk_s
+        r.pop_a, r.pop_k = pop_a, pop_k
+        r.rounds_run += int(chunks[i].shape[0])
+        r.dispatch_s += disp_s
+        r.device_s += device_s
+        last_chunk_s = chunk_s
+        if i > 0 and not fell_back:
+            warm_chunk_s = (
+                chunk_s if warm_chunk_s is None
+                else min(warm_chunk_s, chunk_s)
+            )
+        h = fetch_global_async(curve)
+        handles.append(h)
+        return h
+
+    def run_sync():
+        """One chunk at a time, fully retired before the next dispatch
+        (the chain engine — its reseed is a data dependency — and the
+        ``--no-pipeline`` escape hatch)."""
+        nonlocal sweep_state
+        for i in range(n):
+            if deadline is not None and i >= 1:
+                est = warm_chunk_s if warm_chunk_s is not None else prior_s
+                if est is not None and (
+                    deadline - time.perf_counter() < est * 0.9
+                ):  # next chunk won't fit
+                    r.timed_out = True
+                    return
+            with _otrace.span("chunk", index=i) as _sp:
+                tc = time.perf_counter()
+                (new_state, pop_a, pop_k, curve, disp_s), fb = (
+                    dispatch_or_fallback(i, sweep_state)
+                )
+                tw = time.perf_counter()
+                jax.block_until_ready(pop_a)
+                device_s = time.perf_counter() - tw
+                if engine == "sweep":
+                    # commit only after the sync: a failed dispatch
+                    # (e.g. Mosaic lowering, retried on XLA) must not
+                    # poison the carried populations
+                    sweep_state = new_state
+                h = retire_common(i, pop_a, pop_k, curve, disp_s,
+                                  device_s, time.perf_counter() - tc, fb)
+                chunk_attrs(_sp, i, disp_s, device_s, 0.0, h, r.scorer)
+            if boundary(i):
+                return
+            if deadline is not None and time.perf_counter() > deadline:
+                r.timed_out = i + 1 < n
+                return
+
+    def run_pipelined():
+        """Double-buffered sweep dispatch: chunk i+1 enters the device
+        queue before chunk i's results are waited on, so every piece of
+        chunk i's boundary work (curve transfer, certificates,
+        constructor adoption, checkpoint writes in the caller) executes
+        while the device is busy."""
+        nonlocal sweep_state
+        r.pipelined = True
+        t_mark = time.perf_counter()
+        pending, pend_fb = dispatch_or_fallback(0, sweep_state)
+        i = 0
+        while True:
+            new_state, pop_a, pop_k, curve, disp_s = pending
+            # the scorer THIS chunk executed under: a failing
+            # speculative dispatch below flips r.scorer before chunk
+            # i's attrs are written
+            ran_scorer = r.scorer
+            nxt = None
+            if i + 1 < n:
+                # speculative dispatch BEFORE retiring chunk i: the
+                # device queue never drains while the host works.
+                # Outside chunk i's span, so the mesh-level
+                # dispatch/compile sub-spans of chunk i+1 parent under
+                # the LADDER span rather than the wrong chunk.
+                try:
+                    nxt = dispatch(i + 1, new_state)
+                except Exception as e:
+                    # an execution-time failure has consumed the
+                    # donated new_state — nothing left to retry on
+                    if not _is_lowering(e) or not _leaves_alive(
+                        new_state
+                    ):
+                        raise
+                    # drain-and-retry: retire chunk i with nothing in
+                    # flight; the synchronous XLA retry happens once
+                    # this boundary's work is done
+                    _note_fallback(i + 1, e)
+            with _otrace.span("chunk", index=i) as _sp:
+                tw = time.perf_counter()
+                jax.block_until_ready(pop_a)
+                device_s = time.perf_counter() - tw
+                sweep_state = new_state  # synced: commit
+                now = time.perf_counter()
+                h = retire_common(i, pop_a, pop_k, curve, disp_s,
+                                  device_s, now - t_mark, pend_fb)
+                t_mark = now
+                tb = time.perf_counter()
+                stop = boundary(i)
+                boundary_s = time.perf_counter() - tb
+                overlap = boundary_s if nxt is not None else 0.0
+                r.boundary_overlap_s += overlap
+                chunk_attrs(_sp, i, disp_s, device_s, overlap, h,
+                            ran_scorer)
+            if stop or i + 1 >= n:
+                # certified (the in-flight speculation, if any, is
+                # abandoned — its results are never read) or done
+                return
+            if deadline is not None:
+                # pipeline-aware deadline: chunk i+1 is already on the
+                # device; the clock decides whether to RETIRE it, not
+                # whether to dispatch it. Abandoning costs only
+                # speculative device work.
+                now = time.perf_counter()
+                est = warm_chunk_s if warm_chunk_s is not None else prior_s
+                if now > deadline or (
+                    est is not None and deadline - now < est * 0.9
+                ):
+                    r.timed_out = True
+                    return
+            if nxt is not None:
+                pending, pend_fb = nxt, False
+            else:
+                # the pipeline drained at a fallback: retry the failed
+                # chunk synchronously (compiles the XLA solver — the
+                # chunk is warm-estimate-excluded like chunk 0), then
+                # speculation resumes from the next iteration
+                pending, _ = dispatch_or_fallback(i + 1, sweep_state)
+                pend_fb = True
+            i += 1
+
     prof = (
         jax.profiler.trace(profile_dir)  # SURVEY.md §5 tracing/profiling
         if profile_dir
         else contextlib.nullcontext()
     )
     with prof:
-        deadline = None if time_limit_s is None else t0 + time_limit_s
-        # chunk 0's duration is compile-inclusive and wildly overstates a
-        # warm chunk, so it must not gate chunk 1 — a cold solve with
-        # budget left would otherwise stop after one chunk. The post-chunk
-        # deadline check below still bounds the overshoot.
-        warm_chunk_s: float | None = None
-        for i, temps in enumerate(chunks):
-            if deadline is not None and i > 1 and warm_chunk_s is not None:
-                left = deadline - time.perf_counter()
-                if left < warm_chunk_s * 0.9:  # next chunk won't fit
-                    r.timed_out = True
-                    break
-            tc = time.perf_counter()
-            if len(chunks) == 1:
-                sub = key  # bit-identical to the unchunked solve
-            else:
-                key, sub = jax.random.split(key)
-
-            def run_chunk():
-                nonlocal sweep_state
-                out = solve_on_mesh(
-                    m, seed_dev, sub, mesh, chains_per_device, rounds,
-                    steps_per_round, engine=engine, temps=temps,
-                    scorer=r.scorer, state=sweep_state,
-                )
-                if engine == "sweep":
-                    new_state, pop_a, pop_k, curve = out
-                else:
-                    new_state, (pop_a, pop_k, curve) = None, out
-                jax.block_until_ready(pop_a)
-                if engine == "sweep":
-                    # commit only after the sync: a failed dispatch (e.g.
-                    # Mosaic lowering, retried on XLA) must not poison
-                    # the carried populations
-                    sweep_state = new_state
-                return pop_a, pop_k, curve
-
-            with _otrace.span("chunk", index=i) as _sp:
-                try:
-                    r.pop_a, r.pop_k, curve = run_chunk()
-                except Exception as e:
-                    # only a Mosaic/Pallas lowering failure warrants the
-                    # XLA retry; anything else (OOM, sharding bug,
-                    # regression) must surface with its real traceback
-                    msg = f"{type(e).__name__}: {e}"
-                    is_lowering = r.scorer == "pallas" and any(
-                        s in msg for s in ("Mosaic", "mosaic", "pallas",
-                                           "Pallas", "lowering", "Lowering")
-                    )
-                    if not is_lowering:
-                        raise
-                    r.pallas_fallback = repr(e)[:500]
-                    r.scorer = "xla"
-                    _olog.warn("pallas_fallback", chunk=i,
-                               error=repr(e)[:200])
-                    r.pop_a, r.pop_k, curve = run_chunk()
-                chunk_s = time.perf_counter() - tc
-                if i > 0:
-                    warm_chunk_s = (
-                        chunk_s if warm_chunk_s is None
-                        else min(warm_chunk_s, chunk_s)
-                    )
-                r.rounds_run += temps.shape[0]
-                r.curves.append(np.asarray(fetch_global(curve)))
-                if _sp is not None:
-                    # per-chunk annealing stats: the best-score curve is
-                    # the exact record the device already returns, so
-                    # accepts/declines are measured at best-curve
-                    # granularity (rounds that did / did not improve the
-                    # global best) — no extra device outputs, trajectory
-                    # bit-parity untouched
-                    t_np = np.asarray(temps)
-                    best = r.curves[-1].max(axis=0)
-                    imp = (
-                        int((np.diff(best) > 0).sum())
-                        if best.size > 1 else 0
-                    )
-                    _sp.set(
-                        rounds=int(t_np.shape[0]),
-                        t_hi=float(t_np[0]),
-                        t_lo=float(t_np[-1]),
-                        scorer=r.scorer,
-                        dispatch_s=round(chunk_s, 4),
-                        energy_before=int(best[0]) if best.size else None,
-                        energy_after=int(best[-1]) if best.size else None,
-                        accepts=imp,
-                        declines=max(0, int(best.size) - 1 - imp),
-                    )
-            if i + 1 < len(chunks):
-                # a finished constructor worker short-circuits the rest
-                # of the ladder with its certified plan
-                if lp_fut is not None and lp_fut.done():
-                    try:
-                        plan, ok, _extends = lp_fut.result()
-                    except Exception:
-                        plan, ok = None, False
-                    if ok:
-                        r.certified_a = np.asarray(plan, dtype=np.int32)
-                        r.constructed = True
-                        break
-                # boundary certificate: if any per-shard winner provably
-                # hits the optimum, the remaining chunks cannot improve
-                # it. (The sweep engine's populations continue on-device
-                # via sweep_state and need no boundary host data until a
-                # check actually runs — it skips even the device_get;
-                # the chain engine always needs it for the reseed.)
-                est_chunk_s = warm_chunk_s or chunk_s
-                remaining_s = (len(chunks) - i - 1) * est_chunk_s
-                do_cert = (
-                    not multi
-                    and remaining_s > cert_min_savings_s
-                    and bounds_fut.done()
-                )
-                if engine != "sweep" or do_cert:
-                    pa, pk = (
-                        np.asarray(x)
-                        for x in fetch_global((r.pop_a, r.pop_k))
-                    )
-                    # test ONLY the top-ranked shard winner: the key
-                    # ranks by weight, so a lower-ranked candidate
-                    # cannot pass a weight bound the top one failed,
-                    # and repeating the reseat LP per shard per
-                    # boundary would cost seconds for no new outcome
-                    for j in np.argsort(-pk)[:1] if do_cert else []:
-                        # bucket-padded rows are sliced off before any
-                        # host-side oracle sees the candidate
-                        cand = arrays.unpad_candidate(pa[j], inst)
-                        mc = inst.move_count(cand)
-                        if not inst.is_feasible(cand):
-                            continue
-                        lb_exact, ub0 = bounds_fut.result()
-                        if mc <= lb_exact:
-                            w_cand = inst.preservation_weight(cand)
-                            if w_cand < ub0 and reseat_tries < 3:
-                                # below the bound: a leader reseat can
-                                # lift it. The negative-cycle canceller
-                                # handles a near-optimal candidate in
-                                # well under a second even at 150k
-                                # slots (r4), so every size gets at
-                                # most 3 boundary tries — the final
-                                # certification reseats once regardless
-                                reseat_tries += 1
-                                cand = inst.best_leader_assignment(cand)
-                                w_cand = inst.preservation_weight(cand)
-                            if w_cand >= ub0:
-                                r.certified_a = cand
-                                break
-                            # tier 0 failed: evaluate the tight tier-1
-                            # LP on a worker thread — several seconds
-                            # at 10k partitions; the devices keep
-                            # annealing meanwhile
-                            if r.tight_fut is None:
-                                r.tight_fut = _BoundsTask(
-                                    lambda: inst.weight_upper_bound(
-                                        tight=True
-                                    )
-                                )
-                            elif r.tight_fut.done() and (
-                                w_cand >= r.tight_fut.result()
-                            ):
-                                r.certified_a = cand
-                                break
-                    if r.certified_a is not None:
-                        break
-                    if do_cert and polish_starter is not None:
-                        # a certificate check ran and did NOT certify:
-                        # first evidence this instance may need the
-                        # steepest-descent polish — start its AOT
-                        # compile now so it overlaps the remaining
-                        # chunks. Deferred until here (r5) because the
-                        # certify-first design means most at-scale
-                        # solves never polish, and on few-core hosts an
-                        # eager compile thread STEALS the cpu the main
-                        # compile needs (measured: the two ~20 s
-                        # compiles serialize and double the cold start).
-                        polish_starter()
-                    if engine != "sweep":
-                        seed_dev = jnp.asarray(pa[int(np.argmax(pk))])
-            if deadline is not None and time.perf_counter() > deadline:
-                r.timed_out = i + 1 < len(chunks)
-                break
+        if pipeline and engine == "sweep" and n > 1:
+            run_pipelined()
+        else:
+            run_sync()
+    # materialize the deferred curve transfers (each copy was started
+    # at its chunk's retire — by now they are host-resident; traced
+    # solves already consumed them in chunk_attrs, which caches)
+    r.curves = [np.asarray(h.get()) for h in handles]
+    if warm_chunk_s is not None:
+        _WARM_CHUNKS.update(_wkey(), warm_chunk_s)
     return r
 
 
@@ -1034,6 +1312,7 @@ def _solve_tpu_inner(
     n_devices, engine, checkpoint, profile_dir, time_limit_s,
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
     lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
+    pipeline=True,
 ) -> SolveResult:
     timed_out = False
     early_stopped = False
@@ -1214,6 +1493,12 @@ def _solve_tpu_inner(
         polish_fut_box.append(_BoundsTask(_aot_polish))
 
     if chunks:
+        # warm-chunk estimates are propagated across solves per
+        # executable identity; the "single" tag keeps this sequential
+        # path's estimates disjoint from the batched lane path's (a
+        # batched chunk does L lanes of device work per dispatch)
+        warm_key = ("single", engine, n_dev, chains_per_device,
+                    steps_per_round, int(bkt_parts), int(bkt_rf))
         with _otrace.span("ladder", engine=engine,
                           chunks=len(chunks)) as _sp:
             lad = _run_ladder(
@@ -1221,10 +1506,16 @@ def _solve_tpu_inner(
                 engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
                 bounds_fut, multi, cert_min_savings_s, t0, time_limit_s,
                 profile_dir, polish_starter=_start_polish_aot,
+                pipeline=pipeline, warm_key=warm_key,
             )
             if _sp is not None:
                 _sp.set(rounds_run=lad.rounds_run,
                         timed_out=lad.timed_out, scorer=lad.scorer,
+                        pipelined=lad.pipelined,
+                        dispatch_s=round(lad.dispatch_s, 4),
+                        device_s=round(lad.device_s, 4),
+                        boundary_overlap_s=round(
+                            lad.boundary_overlap_s, 4),
                         boundary_certified=lad.certified_a is not None)
     else:
         # constructed fast path: the ladder never runs, and calling into
@@ -1386,6 +1677,14 @@ def _solve_tpu_inner(
             "steps_per_round": steps_per_round,
             "steps_per_round_ignored": steps_per_round_ignored,
             "scorer": scorer,
+            # double-buffered ladder dispatch (docs/PIPELINE.md): True
+            # when speculative dispatch actually ran, plus the overlap
+            # accounting — boundary host work hidden behind device
+            # chunks, and the host-side enqueue vs device-wait split
+            "pipeline": lad.pipelined,
+            "dispatch_s": round(lad.dispatch_s, 4),
+            "device_s": round(lad.device_s, 4),
+            "boundary_overlap_s": round(lad.boundary_overlap_s, 4),
             **({"pallas_fallback": pallas_fallback} if pallas_fallback
                else {}),
             # certify-first outcome at final selection (None when a
@@ -1432,6 +1731,7 @@ def solve_tpu_batch(
     time_limit_s: float | None = None,
     certify: bool = False,
     trace: bool | str | None = None,
+    pipeline: bool | None = None,
 ) -> list[SolveResult]:
     """Solve L independent instances in ONE batched device dispatch —
     the multi-tenant throughput path (serve's coalescing dispatcher and
@@ -1469,8 +1769,14 @@ def solve_tpu_batch(
     ``trace`` records ONE span-level solve report for the whole batch
     (obs.trace): every lane's stats carry the shared ``trace_id`` and
     ``solve_report``, and the report registers in the /debug/solves
-    ring buffer."""
+    ring buffer.
+
+    ``pipeline`` controls the double-buffered ladder dispatch exactly
+    as in :func:`solve_tpu` (docs/PIPELINE.md): the sweep engine's
+    chunk i+1 is dispatched before chunk i is retired. None defers to
+    the process default."""
     t0 = time.perf_counter()
+    pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
     if not insts:
         return []
     if isinstance(seeds, int):
@@ -1498,7 +1804,8 @@ def solve_tpu_batch(
                                   batch=batch, rounds=rounds,
                                   sweeps=sweeps, t_hi=t_hi, t_lo=t_lo,
                                   n_devices=n_devices,
-                                  time_limit_s=time_limit_s)
+                                  time_limit_s=time_limit_s,
+                                  pipeline=pipeline)
                 r.stats["lane_fallback"] = (
                     "brokers/racks differ across lanes"
                 )
@@ -1516,7 +1823,7 @@ def solve_tpu_batch(
                 insts, seeds, engine, batch, rounds, sweeps, t_hi, t_lo,
                 n_devices, time_limit_s, certify, t0, L,
                 fetch_global, make_mesh, solve_lanes,
-                enable_compile_cache, ensure_backend, bucket,
+                enable_compile_cache, ensure_backend, bucket, pipeline,
             )
     except BaseException as e:
         if tr is not None:
@@ -1539,7 +1846,7 @@ def solve_tpu_batch(
 def _solve_batch_body(
     insts, seeds, engine, batch, rounds, sweeps, t_hi, t_lo, n_devices,
     time_limit_s, certify, t0, L, fetch_global, make_mesh, solve_lanes,
-    enable_compile_cache, ensure_backend, bucket,
+    enable_compile_cache, ensure_backend, bucket, pipeline=True,
 ) -> list[SolveResult]:
     for inst in insts:
         inst._bounds_cancelled = False
@@ -1605,77 +1912,123 @@ def _solve_batch_body(
     # state, so a chunked schedule is bit-identical to the uncut one;
     # the chain engine reseeds each lane from its best-so-far at the
     # boundary, exactly like the single path's reseed)
+    from ...parallel.mesh import fetch_global_async
+
     deadline = None if time_limit_s is None else t0 + time_limit_s
     chunks = _build_chunks(biggest, engine, rounds, t_hi, t_lo,
                            time_limit_s)
+    n = len(chunks)
     state = None
     cur_seeds, cur_keys = lane_seeds, keys
-    curves: list = []
+    handles: list = []  # per-chunk async curve transfers
     rounds_run = 0
     timed_out = False
     pop_a = pop_k = None
     pallas_fallback = None
+    pipelined = False
+    # warm-chunk estimate: per-solve measurement (chunk 0 and fallback
+    # chunks excluded — compile-inclusive) plus the cross-solve prior.
+    # The "lanes" tag + L keep this key space disjoint from the
+    # sequential path's: a slow first batched chunk must never inflate
+    # solve_tpu's deadline estimate, and vice versa.
     warm_chunk_s: float | None = None
+    chunk_len = int(chunks[0].shape[0]) if n else 0
+    warm_key = ("lanes", L, engine, n_dev, chains_per_device,
+                steps_per_round, int(bkt_parts), int(bkt_rf))
 
-    def run_chunk(scorer_now, chunk_temps, state):
+    def _wkey():
+        return (*warm_key, chunk_len, scorer)
+
+    prior_s = _WARM_CHUNKS.get(_wkey())
+
+    def dispatch(ci, st):
+        """Enqueue chunk ci (no wait); timed internally so a fallback
+        retry times the successful dispatch only."""
+        td = time.perf_counter()
         out = solve_lanes(
-            m_stack, mesh, chains_per_device, chunk_temps, state=state,
+            m_stack, mesh, chains_per_device, chunks[ci], state=st,
             lane_seeds=cur_seeds, keys=cur_keys, engine=engine,
-            steps_per_round=steps_per_round, scorer=scorer_now,
+            steps_per_round=steps_per_round, scorer=scorer,
         )
         if engine == "sweep":
             new_state, pa, pk, cv = out
         else:
             new_state, (pa, pk, cv) = None, out
-        jax.block_until_ready(pa)
-        return new_state, pa, pk, cv
+        return new_state, pa, pk, cv, time.perf_counter() - td
 
-    with _otrace.span("ladder", engine=engine,
-                      chunks=len(chunks)) as _lsp:
-        for ci, chunk_temps in enumerate(chunks):
-            if (deadline is not None and ci > 1
-                    and warm_chunk_s is not None):
-                # chunk 0 is compile-inclusive; only warm chunks gate
-                if deadline - time.perf_counter() < warm_chunk_s * 0.9:
+    def _is_lowering(e):
+        msg = f"{type(e).__name__}: {e}"
+        return scorer == "pallas" and any(
+            s in msg for s in ("Mosaic", "mosaic", "pallas", "Pallas",
+                               "lowering", "Lowering")
+        )
+
+    def _note_fb(ci, e):
+        nonlocal scorer, pallas_fallback, warm_chunk_s, prior_s
+        pallas_fallback = repr(e)[:500]
+        scorer = "xla"
+        # restart the warm measurement under the new scorer key (see
+        # the single path's _note_fallback)
+        warm_chunk_s = None
+        prior_s = _WARM_CHUNKS.get(_wkey())
+        _olog.warn("pallas_fallback", chunk=ci, error=repr(e)[:200])
+
+    def dispatch_or_fallback(ci, st):
+        try:
+            return dispatch(ci, st), False
+        except Exception as e:
+            # execution-time failures have consumed the donated state;
+            # only trace/compile-time lowering errors may retry
+            if not _is_lowering(e) or not _leaves_alive(st):
+                raise
+            _note_fb(ci, e)
+            return dispatch(ci, st), True
+
+    def retire(ci, pa, pk, cv, disp_s, device_s, chunk_s, fb, sp,
+               overlap_s, scorer_ran=None):
+        nonlocal pop_a, pop_k, rounds_run, warm_chunk_s
+        pop_a, pop_k = pa, pk
+        rounds_run += int(chunks[ci].shape[0])
+        handles.append(fetch_global_async(cv))
+        if ci > 0 and not fb:
+            warm_chunk_s = (
+                chunk_s if warm_chunk_s is None
+                else min(warm_chunk_s, chunk_s)
+            )
+        if sp is not None:
+            t_np = np.asarray(chunks[ci])
+            sp.set(rounds=int(t_np.shape[0]), t_hi=float(t_np[0]),
+                   t_lo=float(t_np[-1]),
+                   scorer=scorer if scorer_ran is None else scorer_ran,
+                   dispatch_s=round(disp_s, 4),
+                   device_s=round(device_s, 4),
+                   boundary_overlap_s=round(overlap_s, 4))
+
+    def run_sync():
+        nonlocal state, cur_seeds, cur_keys, timed_out
+        for ci in range(n):
+            if deadline is not None and ci >= 1:
+                est = (warm_chunk_s if warm_chunk_s is not None
+                       else prior_s)
+                if est is not None and (
+                    deadline - time.perf_counter() < est * 0.9
+                ):
                     timed_out = True
-                    break
+                    return
             tc = time.perf_counter()
             with _otrace.span("chunk", index=ci) as _sp:
-                try:
-                    state, pop_a, pop_k, cv = run_chunk(
-                        scorer, chunk_temps, state
-                    )
-                except Exception as e:
-                    msg = f"{type(e).__name__}: {e}"
-                    is_lowering = scorer == "pallas" and any(
-                        s in msg for s in ("Mosaic", "mosaic", "pallas",
-                                           "Pallas", "lowering",
-                                           "Lowering")
-                    )
-                    if not is_lowering:
-                        raise
-                    pallas_fallback = repr(e)[:500]
-                    scorer = "xla"
-                    _olog.warn("pallas_fallback", chunk=ci,
-                               error=repr(e)[:200])
-                    state, pop_a, pop_k, cv = run_chunk(
-                        scorer, chunk_temps, state
-                    )
-                chunk_s = time.perf_counter() - tc
-                if _sp is not None:
-                    t_np = np.asarray(chunk_temps)
-                    _sp.set(rounds=int(t_np.shape[0]),
-                            t_hi=float(t_np[0]), t_lo=float(t_np[-1]),
-                            scorer=scorer, dispatch_s=round(chunk_s, 4))
-            if ci > 0:
-                warm_chunk_s = (
-                    chunk_s if warm_chunk_s is None
-                    else min(warm_chunk_s, chunk_s)
+                (new_state, pa, pk, cv, disp_s), fb = (
+                    dispatch_or_fallback(ci, state)
                 )
-            rounds_run += int(chunk_temps.shape[0])
-            curves.append(cv)
-            over = deadline is not None and time.perf_counter() > deadline
-            if engine != "sweep" and ci + 1 < len(chunks) and not over:
+                tw = time.perf_counter()
+                jax.block_until_ready(pa)
+                device_s = time.perf_counter() - tw
+                state = new_state
+                retire(ci, pa, pk, cv, disp_s, device_s,
+                       time.perf_counter() - tc, fb, _sp, 0.0)
+            over = (deadline is not None
+                    and time.perf_counter() > deadline)
+            if engine != "sweep" and ci + 1 < n and not over:
                 # chain boundary reseed: each lane continues from its
                 # best shard winner with a fresh per-lane key stream
                 pa_np = np.asarray(fetch_global(pop_a))
@@ -1686,11 +2039,83 @@ def _solve_batch_body(
                 ).astype(np.int32)
                 cur_keys = jax.vmap(jax.random.split)(cur_keys)[:, 1]
             if over:
-                timed_out = ci + 1 < len(chunks)
-                break
+                timed_out = ci + 1 < n
+                return
+
+    def run_pipelined():
+        """Sweep lanes, double-buffered: chunk ci+1 enters the device
+        queue before chunk ci's results are waited on — same dispatch
+        discipline as the single path (docs/PIPELINE.md); the per-lane
+        state is donated, so each chunk updates HBM in place."""
+        nonlocal state, timed_out, pipelined
+        pipelined = True
+        t_mark = time.perf_counter()
+        pending, pend_fb = dispatch_or_fallback(0, state)
+        ci = 0
+        while True:
+            new_state, pa, pk, cv, disp_s = pending
+            ran_scorer = scorer  # before a speculation failure flips it
+            nxt = None
+            if ci + 1 < n:
+                # outside chunk ci's span — see the single path's
+                # run_pipelined for the span-parenting rationale
+                try:
+                    nxt = dispatch(ci + 1, new_state)
+                except Exception as e:
+                    if not _is_lowering(e) or not _leaves_alive(
+                        new_state
+                    ):
+                        raise
+                    # drain-and-retry: retire chunk ci with nothing
+                    # in flight; the XLA retry happens below
+                    _note_fb(ci + 1, e)
+            with _otrace.span("chunk", index=ci) as _sp:
+                tw = time.perf_counter()
+                jax.block_until_ready(pa)
+                device_s = time.perf_counter() - tw
+                state = new_state
+                now = time.perf_counter()
+                retire(ci, pa, pk, cv, disp_s, device_s, now - t_mark,
+                       pend_fb, _sp, 0.0, scorer_ran=ran_scorer)
+                if nxt is not None and _sp is not None:
+                    # the retire's host work (async curve start, span
+                    # attrs) ran while chunk ci+1 was on the device
+                    _sp.set(boundary_overlap_s=round(
+                        time.perf_counter() - now, 4))
+                t_mark = now
+            if ci + 1 >= n:
+                return
+            if deadline is not None:
+                # pipeline-aware deadline: decide whether to RETIRE
+                # the in-flight chunk, not whether to dispatch it
+                now = time.perf_counter()
+                est = (warm_chunk_s if warm_chunk_s is not None
+                       else prior_s)
+                if now > deadline or (
+                    est is not None and deadline - now < est * 0.9
+                ):
+                    timed_out = True
+                    return
+            if nxt is not None:
+                pending, pend_fb = nxt, False
+            else:
+                # drained at a Pallas fallback: synchronous XLA retry,
+                # then the pipeline re-enters
+                pending, _ = dispatch_or_fallback(ci + 1, state)
+                pend_fb = True
+            ci += 1
+
+    with _otrace.span("ladder", engine=engine,
+                      chunks=len(chunks)) as _lsp:
+        if pipeline and engine == "sweep" and n > 1:
+            run_pipelined()
+        else:
+            run_sync()
         if _lsp is not None:
             _lsp.set(rounds_run=rounds_run, timed_out=timed_out,
-                     scorer=scorer)
+                     scorer=scorer, pipelined=pipelined)
+    if warm_chunk_s is not None:
+        _WARM_CHUNKS.update(_wkey(), warm_chunk_s)
     t_solve = time.perf_counter()
 
     # per-lane final selection on the host: rank each lane's per-shard
@@ -1698,7 +2123,7 @@ def _solve_batch_body(
     # numpy oracle (n_dev candidates per lane, a few hundred KB total)
     pa = np.asarray(fetch_global(pop_a))  # [n_dev, L, P, R]
     curve_np = np.concatenate(
-        [np.asarray(fetch_global(c)) for c in curves], axis=2
+        [np.asarray(h.get()) for h in handles], axis=2
     )  # [n_dev, L, rounds_run]
     wall = time.perf_counter() - t0
     with _otrace.span("verify", lanes=L) as _vsp:
@@ -1706,7 +2131,7 @@ def _solve_batch_body(
             insts, pa, curve_np, n_dev, certify, wall, t_solve, t0,
             platform, engine, L, chains_per_device, rounds, rounds_run,
             timed_out, bkt_parts, bkt_rf, scorer, pallas_fallback,
-            time_limit_s, seed_moves,
+            time_limit_s, seed_moves, pipelined,
         )
         if _vsp is not None:
             _vsp.set(lanes_feasible=sum(
@@ -1718,6 +2143,7 @@ def _select_lanes(
     insts, pa, curve_np, n_dev, certify, wall, t_solve, t0, platform,
     engine, L, chains_per_device, rounds, rounds_run, timed_out,
     bkt_parts, bkt_rf, scorer, pallas_fallback, time_limit_s, seed_moves,
+    pipelined=False,
 ) -> list[SolveResult]:
     """Per-lane final selection + oracle verification (the batch path's
     "verify" phase body)."""
@@ -1755,6 +2181,7 @@ def _select_lanes(
                 "bucket_parts": int(bkt_parts),
                 "bucket_rf": int(bkt_rf),
                 "scorer": scorer,
+                "pipeline": pipelined,
                 **({"pallas_fallback": pallas_fallback}
                    if pallas_fallback else {}),
                 "proved_optimal": proved,

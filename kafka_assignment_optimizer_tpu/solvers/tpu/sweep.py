@@ -862,7 +862,15 @@ def make_sweep_stepper_fn(
     snapshot cadence and the exchange-sweep parity (engine chunks are a
     multiple of snapshot_every), a chunked run is bit-identical to the
     uncut ladder: chunking changes only where the host may look, never
-    the search trajectory."""
+    the search trajectory.
+
+    Donation contract (docs/PIPELINE.md): every state leaf has an
+    identically shaped/dtyped output leaf in ``state'``, which is what
+    lets ``parallel.mesh`` mark the state argument donated — XLA then
+    aliases the input buffers to the output and a chunk updates the
+    populations in HBM in place. Callers must treat a state handed to
+    one dispatch as CONSUMED and continue from the returned ``state'``
+    only; the runtime enforces this (reuse raises, CPU included)."""
     sc = _make_scorer(scorer)
     hists, full = sc.hists, sc.full
     site_step, exch_step = sc.site_step, sc.exch_step

@@ -1,0 +1,201 @@
+"""Donated-buffer smoke tests (ISSUE 4 satellite, docs/PIPELINE.md).
+
+The sweep solvers donate their carried state (``parallel.mesh``
+``donate_argnums``) so each ladder chunk updates the chain populations
+in HBM in place. The runtime enforces the donation contract even on the
+CPU test mesh — a donated array is deleted at dispatch and reuse raises
+— which is exactly what makes these tests tier-1-safe TPU insurance:
+any code path that touches a state after handing it to a dispatch fails
+HERE, in CPU CI, not in the first TPU bench run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+from kafka_assignment_optimizer_tpu.models.instance import build_instance
+from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+from kafka_assignment_optimizer_tpu.solvers.tpu.arrays import (
+    geometric_temps,
+)
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+
+def _instance(rng, n_brokers=10, n_parts=24, rf=3, n_racks=2):
+    parts = [
+        PartitionAssignment(
+            "t", p, rng.choice(n_brokers, size=rf, replace=False).tolist()
+        )
+        for p in range(n_parts)
+    ]
+    topo = Topology(
+        rack_of={b: f"r{b % n_racks}" for b in range(n_brokers)}
+    )
+    return build_instance(
+        Assignment(partitions=parts), list(range(n_brokers - 1)), topo
+    )
+
+
+def test_sweep_state_is_donated_and_reuse_raises(rng):
+    """The single-instance sweep solver consumes its state: after one
+    dispatch the input buffers are gone (in-place HBM update — no
+    per-chunk full-population reallocation), and feeding the same state
+    to a second dispatch raises instead of silently reading freed
+    memory. Continuing from the RETURNED state — the engine's usage
+    pattern — works across chunks."""
+    inst = _instance(rng)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(np.asarray(greedy_seed(inst), np.int32))
+    mesh = pm.make_mesh()
+    temps = geometric_temps(2.0, 0.02, 16)
+    state0 = pm.init_sweep_state(m, seed, jax.random.PRNGKey(0), mesh, 2)
+
+    st1, pop_a, pop_k, _curve = pm.solve_on_mesh(
+        m, None, None, mesh, 2, 16, 1, engine="sweep", temps=temps,
+        state=state0,
+    )
+    jax.block_until_ready(pop_a)
+    leaves0 = jax.tree_util.tree_leaves(state0)
+    assert all(x.is_deleted() for x in leaves0), (
+        "sweep state was not donated — per-chunk full-population "
+        "reallocation is back"
+    )
+    # chunk 2 from the returned state: the engine's carried-state pattern
+    st2, pop_a2, _pk2, _c2 = pm.solve_on_mesh(
+        m, None, None, mesh, 2, 16, 1, engine="sweep", temps=temps,
+        state=st1,
+    )
+    jax.block_until_ready(pop_a2)
+    # every candidate is still a real plan for this instance
+    best = arrays.unpad_candidate(np.asarray(pm.fetch_global(pop_a2))[0],
+                                  inst)
+    assert best.shape == (inst.num_parts, inst.max_rf)
+    # reuse of a consumed state must raise loudly, never return garbage
+    with pytest.raises(Exception, match="[Dd]elet|[Dd]onat"):
+        out = pm.solve_on_mesh(
+            m, None, None, mesh, 2, 16, 1, engine="sweep", temps=temps,
+            state=st1,
+        )
+        jax.block_until_ready(out[1])
+
+
+def test_lane_state_is_donated(rng):
+    """Same contract for the batched lane solver: the [n_dev, L, ...]
+    lane state is consumed per dispatch and threads through chunks."""
+    insts = [_instance(rng), _instance(rng)]
+    models = [arrays.from_instance(i) for i in insts]
+    m_stack = arrays.stack_models(models)
+    lane_seeds = np.stack([
+        arrays.pad_candidate(
+            np.asarray(greedy_seed(i), np.int32), mm
+        )
+        for i, mm in zip(insts, models)
+    ])
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in (0, 1)])
+    mesh = pm.make_mesh()
+    temps = geometric_temps(2.0, 0.02, 16)
+    state0 = pm.init_lane_state(m_stack, lane_seeds, keys, mesh, 2)
+
+    st1, pa, _pk, _cv = pm.solve_lanes(
+        m_stack, mesh, 2, temps, state=state0, engine="sweep",
+    )
+    jax.block_until_ready(pa)
+    assert all(
+        x.is_deleted() for x in jax.tree_util.tree_leaves(state0)
+    )
+    st2, pa2, _pk2, _cv2 = pm.solve_lanes(
+        m_stack, mesh, 2, temps, state=st1, engine="sweep",
+    )
+    jax.block_until_ready(pa2)
+
+
+def test_donated_ladder_is_bit_deterministic(rng):
+    """Repeated identical donated ladders must be bit-identical.
+
+    Regression pin: init_sweep_state once fed the SAME
+    ``np.broadcast_to`` view as both the population and best-snapshot
+    leaves; device_put may zero-copy a contiguous-compatible host view,
+    so the two donated leaves could silently share one buffer — and the
+    solver's in-place updates then corrupted the sibling leaf,
+    alignment-dependently (identical solves returned different,
+    lower-quality plans). The state leaves must be independent buffers
+    and the whole donated chunk chain exactly reproducible."""
+    inst = _instance(rng)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(np.asarray(greedy_seed(inst), np.int32))
+    mesh = pm.make_mesh()
+    temps = geometric_temps(2.0, 0.02, 16)
+
+    def run():
+        state = pm.init_sweep_state(
+            m, seed, jax.random.PRNGKey(7), mesh, 2
+        )
+        for _ in range(2):
+            state, pa, pk, cv = pm.solve_on_mesh(
+                m, None, None, mesh, 2, 16, 1, engine="sweep",
+                temps=temps, state=state,
+            )
+        jax.block_until_ready(pa)
+        return (np.asarray(pm.fetch_global(pa)).copy(),
+                np.asarray(pm.fetch_global(cv)).copy())
+
+    pa0, cv0 = run()
+    for _ in range(2):
+        pa_i, cv_i = run()
+        assert np.array_equal(pa0, pa_i)
+        assert np.array_equal(cv0, cv_i)
+
+
+def test_chain_engine_args_not_donated(rng):
+    """The chain engine has no carried state — its seed and keys are
+    plain arguments the engine DOES reuse across chunks, so they must
+    survive a dispatch untouched."""
+    inst = _instance(rng)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(np.asarray(greedy_seed(inst), np.int32))
+    key = jax.random.PRNGKey(0)
+    mesh = pm.make_mesh()
+    ba, bk, _cv = pm.solve_on_mesh(
+        m, seed, key, mesh, 2, 2, 50, engine="chain",
+    )
+    jax.block_until_ready(ba)
+    assert not seed.is_deleted() and not key.is_deleted()
+    # second dispatch with the same args (the engine's reseed pattern)
+    ba2, _bk2, _cv2 = pm.solve_on_mesh(
+        m, seed, key, mesh, 2, 2, 50, engine="chain",
+    )
+    jax.block_until_ready(ba2)
+
+
+def test_engine_end_to_end_through_donated_path(rng):
+    """A chunked sweep solve through the full engine (4 chunks threading
+    donated state, pipelined dispatch on) stays feasible and verified —
+    the CI stand-in for the TPU ladder."""
+    from kafka_assignment_optimizer_tpu.api import optimize
+
+    rng2 = np.random.default_rng(1)
+    parts = [
+        PartitionAssignment(
+            "t", p, rng2.choice(12, size=3, replace=False).tolist()
+        )
+        for p in range(48)
+    ]
+    topo = Topology(rack_of={b: f"r{b % 3}" for b in range(12)})
+    res = optimize(
+        Assignment(partitions=parts), list(range(11)), topo,
+        solver="tpu", engine="sweep", batch=8, rounds=32, seed=0,
+        time_limit_s=3600.0, precompile=True,
+    )
+    st = res.solve.stats
+    assert st["feasible"] is True
+    assert st["rounds_run"] == 32
+    assert st["pipeline"] is True

@@ -185,8 +185,13 @@ def test_cpu_solve_trace_covers_every_phase_once(demo):
     assert chunks, ladder
     for ch in chunks:
         at = ch["attrs"]
+        # dispatch_s (host enqueue) vs device_s (blocked on results) vs
+        # boundary_overlap_s (host boundary work hidden behind the next
+        # in-flight chunk) — the pipelined-dispatch accounting
+        # (docs/PIPELINE.md)
         for k in ("rounds", "t_hi", "t_lo", "energy_before",
-                  "energy_after", "accepts", "declines", "dispatch_s"):
+                  "energy_after", "accepts", "declines", "dispatch_s",
+                  "device_s", "boundary_overlap_s"):
             assert k in at, (k, at)
         assert at["t_hi"] >= at["t_lo"]
         assert at["accepts"] + at["declines"] == max(0, at["rounds"] - 1)

@@ -1,0 +1,192 @@
+"""Pipelined ladder dispatch bit-parity (ISSUE 4, docs/PIPELINE.md).
+
+The double-buffered dispatcher overlaps host boundary work with device
+chunks by dispatching chunk i+1 before chunk i is retired. That is pure
+scheduling: PRNG keys are pre-split in deterministic order and the sweep
+state carries its own RNG, so the pipelined and synchronous
+(``pipeline=False``) solves must agree BIT FOR BIT — final plan, best
+curve, checkpoint contents, and checkpoint-resume replay — for both
+engines, including across a forced mid-ladder Pallas→XLA fallback.
+
+Boundary optimality certificates are disabled via ``cert_min_savings_s``
+in the strict-parity tests: whether a certificate check RUNS depends on
+wall-clock estimates (cold vs warm chunks), which is time-dependent by
+design — the resulting plan is a proven optimum either way, but an
+early-stopped curve is legitimately shorter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.api import optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+
+# a generous never-binding budget: forces the finer 8-piece sweep chunk
+# schedule (and chain chunking) without any risk of a timeout making
+# which chunk is last depend on the clock
+NO_DEADLINE = 3600.0
+
+
+def random_cluster(rng, n_brokers, n_parts, rf, n_racks, drop=0):
+    parts = []
+    for p in range(n_parts):
+        reps = rng.choice(n_brokers, size=rf, replace=False).tolist()
+        parts.append(PartitionAssignment("t", p, [int(b) for b in reps]))
+    topo = Topology(rack_of={b: f"r{b % n_racks}" for b in range(n_brokers)})
+    brokers = list(range(n_brokers - drop))
+    return Assignment(partitions=parts), brokers, topo
+
+
+def _solve(cluster, pipeline, engine, checkpoint=None, **kw):
+    # precompile=True switches the host-side constructor races off (the
+    # engine's own deterministic knob): a race worker finishing between
+    # two particular chunks is wall-clock-dependent and would make the
+    # curve length an accident of thread scheduling, not a pipelining
+    # property. cert_min_savings_s=1e9 pins the boundary certificate
+    # off for the same reason (see module docstring).
+    current, brokers, topo = cluster
+    return optimize(
+        current, brokers, topo, solver="tpu", engine=engine, seed=0,
+        batch=8, pipeline=pipeline, time_limit_s=NO_DEADLINE,
+        cert_min_savings_s=1e9, precompile=True, checkpoint=checkpoint,
+        **kw,
+    )
+
+
+def _assert_parity(r_pipe, r_sync):
+    s_p, s_s = r_pipe.solve.stats, r_sync.solve.stats
+    assert np.array_equal(r_pipe.solve.a, r_sync.solve.a)
+    assert r_pipe.solve.objective == r_sync.solve.objective
+    assert s_p["moves"] == s_s["moves"]
+    assert s_p["rounds_run"] == s_s["rounds_run"]
+    assert s_p["score_curve"] == s_s["score_curve"]
+    assert s_p["feasible"] is True
+
+
+def test_sweep_pipelined_bit_identical_to_sync(rng):
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    r_pipe = _solve(cluster, True, "sweep", rounds=32)
+    r_sync = _solve(cluster, False, "sweep", rounds=32)
+    # the flag actually selected the dispatcher under test: 4 chunks of
+    # 8 sweeps (time-limited sweep schedule), speculation engaged
+    assert r_pipe.solve.stats["pipeline"] is True
+    assert r_sync.solve.stats["pipeline"] is False
+    _assert_parity(r_pipe, r_sync)
+
+
+def test_chain_pipeline_flag_is_inert_and_identical(rng):
+    """The chain engine's boundary reseed is a data dependency, so it
+    never speculates — pipeline=True must be a no-op, not a divergence."""
+    cluster = random_cluster(rng, 10, 20, 2, 2, drop=1)
+    kw = dict(rounds=8, steps_per_round=120)
+    r_pipe = _solve(cluster, True, "chain", **kw)
+    r_sync = _solve(cluster, False, "chain", **kw)
+    assert r_pipe.solve.stats["pipeline"] is False  # never speculated
+    _assert_parity(r_pipe, r_sync)
+
+
+def test_checkpoint_and_resume_replay_identical(rng, tmp_path):
+    """Pipelined and synchronous solves write identical checkpoints,
+    and a resume from either replays to the same plan (SURVEY.md §5:
+    re-solves never regress below the checkpoint)."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.utils import checkpoint as ckpt
+
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    ck_p = str(tmp_path / "pipe" / "ck.npz")
+    ck_s = str(tmp_path / "sync" / "ck.npz")
+    r_pipe = _solve(cluster, True, "sweep", rounds=32, checkpoint=ck_p)
+    r_sync = _solve(cluster, False, "sweep", rounds=32, checkpoint=ck_s)
+    _assert_parity(r_pipe, r_sync)
+    inst = build_instance(*cluster)
+    a_p, a_s = ckpt.load(ck_p, inst), ckpt.load(ck_s, inst)
+    assert a_p is not None and np.array_equal(a_p, a_s)
+    # resume: both modes warm-start from their checkpoint and replay to
+    # the same answer again
+    r_pipe2 = _solve(cluster, True, "sweep", rounds=32, checkpoint=ck_p)
+    r_sync2 = _solve(cluster, False, "sweep", rounds=32, checkpoint=ck_s)
+    assert r_pipe2.solve.stats["resumed_from_checkpoint"] is True
+    assert r_sync2.solve.stats["resumed_from_checkpoint"] is True
+    _assert_parity(r_pipe2, r_sync2)
+    assert np.array_equal(r_pipe2.solve.a, r_pipe.solve.a)
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_forced_midladder_pallas_fallback_parity(rng, monkeypatch,
+                                                 pipeline):
+    """A Mosaic lowering failure on the SECOND pallas dispatch (chunk 1
+    — mid-ladder, so the pipelined path must drain its in-flight
+    speculation, retry synchronously, and re-enter) falls back to the
+    XLA scorer and still produces the synchronous solve's exact answer.
+    CPU has no Mosaic path, so the TPU platform answer is simulated
+    (scorer='pallas' decision) and the pallas-tagged dispatches are
+    delegated to the XLA scorer — which is trajectory-bit-identical by
+    the pinned scorer-parity contract (tests/test_sweep.py)."""
+    from kafka_assignment_optimizer_tpu.parallel import mesh as pmesh
+    from kafka_assignment_optimizer_tpu.utils import platform as plat
+
+    monkeypatch.setattr(plat, "ensure_backend", lambda: "tpu")
+
+    real = pmesh.solve_on_mesh
+    pallas_calls = {"n": 0}
+
+    def fake_solve_on_mesh(*args, **kw):
+        if kw.get("scorer") == "pallas":
+            pallas_calls["n"] += 1
+            if pallas_calls["n"] == 2:  # mid-ladder lowering failure
+                raise RuntimeError(
+                    "Mosaic lowering failed (forced test fallback)"
+                )
+            kw = dict(kw, scorer="xla")
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pmesh, "solve_on_mesh", fake_solve_on_mesh)
+
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    res = _solve(cluster, pipeline, "sweep", rounds=32)
+    st = res.solve.stats
+    assert pallas_calls["n"] == 2  # chunk 0 ran pallas, chunk 1 failed
+    assert "pallas_fallback" in st and "Mosaic" in st["pallas_fallback"]
+    assert st["scorer"] == "xla"
+    assert st["rounds_run"] == 32  # the fallback lost no chunks
+
+    # the baseline: no simulated TPU, plain XLA sweep, synchronous —
+    # the answer every fallback path must reproduce bit-for-bit
+    monkeypatch.setattr(plat, "ensure_backend", lambda: "cpu")
+    monkeypatch.setattr(pmesh, "solve_on_mesh", real)
+    base = _solve(cluster, False, "sweep", rounds=32)
+    assert np.array_equal(res.solve.a, base.solve.a)
+    assert st["score_curve"] == base.solve.stats["score_curve"]
+
+
+def test_batch_lane_pipeline_parity(rng):
+    """solve_tpu_batch: pipelined and synchronous batched ladders agree
+    per lane, bit for bit."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        solve_tpu_batch,
+    )
+
+    insts = [
+        build_instance(*random_cluster(rng, 12, 40 + 4 * i, 3, 3, drop=1))
+        for i in range(3)
+    ]
+    kw = dict(engine="sweep", rounds=32, time_limit_s=NO_DEADLINE)
+    r_pipe = solve_tpu_batch(insts, seeds=0, pipeline=True, **kw)
+    r_sync = solve_tpu_batch(insts, seeds=0, pipeline=False, **kw)
+    assert r_pipe[0].stats["pipeline"] is True
+    assert r_sync[0].stats["pipeline"] is False
+    for a, b in zip(r_pipe, r_sync):
+        assert np.array_equal(a.a, b.a)
+        assert a.stats["score_curve"] == b.stats["score_curve"]
+        assert a.stats["moves"] == b.stats["moves"]
