@@ -1,0 +1,60 @@
+"""``kao-check`` — project-native static analysis + runtime sanitizer.
+
+Three layers, one CLI (``python -m kafka_assignment_optimizer_tpu.analysis``):
+
+- :mod:`.rules_ast` — stdlib-``ast`` lint rules for the JAX footguns
+  this repo has actually shipped (donation reuse, shared broadcast
+  bases, host-float64 leaks, PRNG reuse, trace-time branching, bare
+  prints, undocumented metrics). KAO1xx.
+- :mod:`.contracts` — ``jax.make_jaxpr`` contract checks over the real
+  compiled sweep/lane/chain solvers on a tiny bucket shape (no
+  float64, no host callbacks, donation leaf correspondence, bucket
+  output shapes, independent donated buffers). KAO2xx.
+- :mod:`.sanitize` — the runtime sanitizer (``KAO_SANITIZE=1``): NaN
+  aborts, a recompile sentinel over the executable cache, and a
+  donation use-after-free guard, all counted on ``/metrics``.
+
+See docs/ANALYSIS.md for the rule catalog and suppression syntax.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .findings import RULES, Finding  # noqa: F401
+from .rules_ast import lint_source
+
+_SKIP_DIRS = {"__pycache__", "_build", ".git"}
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_py_files(root: str):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _SKIP_DIRS
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths=None, rules=None) -> list[Finding]:
+    """Run the AST pass over ``paths`` (default: the installed package
+    tree). ``rules`` optionally restricts to a set of KAO IDs."""
+    root = package_root()
+    findings: list[Finding] = []
+    for p in paths or [root]:
+        for path in iter_py_files(p):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            findings.extend(lint_source(text, path, rel=rel))
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    return findings

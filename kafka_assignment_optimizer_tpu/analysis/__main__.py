@@ -1,0 +1,114 @@
+"""``kao-check`` CLI: ``python -m kafka_assignment_optimizer_tpu.analysis``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+The lint pass is pure stdlib and needs no jax; the jaxpr contract pass
+(on by default, ``--no-contracts`` to skip) imports jax on CPU — it
+traces the real solvers abstractly and never compiles or touches a
+device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import RULES, lint_paths, package_root
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="kao-check",
+        description="Project-native static analysis for JAX footguns "
+        "(rule catalog: docs/ANALYSIS.md).",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the package tree)",
+    )
+    ap.add_argument(
+        "--rule", action="append", metavar="KAO1xx",
+        help="restrict the lint pass to these rule IDs (repeatable)",
+    )
+    ap.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the jaxpr contract pass (lint only; no jax import)",
+    )
+    ap.add_argument(
+        "--contracts-only", action="store_true",
+        help="run only the jaxpr contract pass",
+    )
+    ap.add_argument(
+        "--format", choices=["text", "json"], default="text",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.contracts_only and args.no_contracts:
+        # both flags together would run zero checks and exit 0 — a
+        # silent green no-op gate
+        build_parser().error(
+            "--contracts-only and --no-contracts are mutually exclusive"
+        )
+    if args.contracts_only and args.paths:
+        # the contract pass traces the installed package's real
+        # solvers; explicit paths scope the LINT pass only — accepting
+        # both would run zero checks and report a green no-op
+        build_parser().error(
+            "--contracts-only does not take paths (contracts always "
+            "run against the installed package)"
+        )
+    if args.rule:
+        unknown = sorted(set(args.rule) - set(RULES))
+        if unknown:
+            # an unknown ID would filter every finding out and turn a
+            # typo into a permanently green gate
+            build_parser().error(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                "(see --list-rules)"
+            )
+    if args.list_rules:
+        for rid, title in sorted(RULES.items()):
+            # kao: disable=KAO106 -- kao-check's own stdout IS the product
+            print(f"{rid}  {title}")
+        return 0
+    findings = []
+    if not args.contracts_only:
+        findings += lint_paths(args.paths or None,
+                               rules=set(args.rule) if args.rule else None)
+    if not args.no_contracts and (args.contracts_only or not args.paths):
+        # contracts trace the real solvers — meaningful only for the
+        # package itself, so explicit fixture paths skip them
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from .contracts import run_contracts
+
+        rep = run_contracts()
+        findings += rep.findings
+    if args.format == "json":
+        # kao: disable=KAO106 -- kao-check's own stdout IS the product
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=2
+        ))
+    else:
+        for f in findings:
+            # kao: disable=KAO106 -- kao-check's own stdout IS the product
+            print(f.render())
+        root = args.paths or [package_root()]
+        # kao: disable=KAO106 -- kao-check's own stdout IS the product
+        print(
+            f"kao-check: {len(findings)} finding(s) in "
+            f"{', '.join(root)}"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,297 @@
+"""jaxpr / abstract-eval contract checker (``kao-check --contracts``).
+
+The AST pass reads what the code *says*; this pass reads what the
+compiler will actually *do*: it traces the real sweep / lane / chain
+solvers (``jax.make_jaxpr`` — abstract eval only, no compile, no
+device) on a tiny bucket shape and asserts the static contracts the
+engine relies on:
+
+- **no concrete float64 anywhere in the jaxpr** (weak-typed scalar
+  literals excluded — they adapt to context): the device consumes
+  float32, and a host-float64 dependency is the PR 2 trajectory break.
+- **no host callbacks in the hot path**: a stray ``debug_callback`` /
+  ``pure_callback`` / ``io_callback`` in the sweep loop serializes
+  every round through the host.
+- **donation leaf correspondence**: the sweep/lane steppers' carried
+  state must come back leaf-for-leaf identical in shape AND dtype —
+  the precondition for ``donate_argnums`` updating HBM in place.
+- **output shapes match the bucket ladder**: the traced solvers emit
+  plans at the canonical padded bucket shape, not the raw instance
+  shape (executable reuse depends on it).
+- **donated leaves are independent buffers**: the mesh-level initial
+  states must not alias two pytree leaves to one device buffer (the
+  PR 4 corruption — two views of a shared broadcast base, donated).
+
+Runs on CPU in a couple of seconds; CI-safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .findings import Finding
+
+_CALLBACK_PRIMS = (
+    "pure_callback", "io_callback", "debug_callback", "python_callback",
+    "callback", "outside_call", "host_callback",
+)
+
+
+@dataclass
+class ContractReport:
+    findings: list
+    checks_run: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _walk_jaxpr(jaxpr):
+    """Yield (eqn, jaxpr) for every equation, recursing into nested
+    jaxprs (scan/while/cond bodies, pjit calls)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for p in eqn.params.values():
+            for sub in _subjaxprs(p):
+                yield from _walk_jaxpr(sub)
+
+
+def _subjaxprs(p):
+    import jax
+
+    core = jax.core
+    if isinstance(p, core.ClosedJaxpr):
+        yield p.jaxpr
+    elif isinstance(p, core.Jaxpr):
+        yield p
+    elif isinstance(p, (tuple, list)):
+        for item in p:
+            yield from _subjaxprs(item)
+
+
+def _avals_of(jaxpr):
+    for v in [*jaxpr.invars, *jaxpr.constvars, *jaxpr.outvars]:
+        yield getattr(v, "aval", None)
+    for eqn in _walk_jaxpr(jaxpr):
+        for v in [*eqn.invars, *eqn.outvars]:
+            yield getattr(v, "aval", None)
+
+
+def _check_jaxpr(closed, name: str, findings: list) -> None:
+    import numpy as np
+
+    jaxpr = closed.jaxpr
+    for aval in _avals_of(jaxpr):
+        dtype = getattr(aval, "dtype", None)
+        if dtype is None:
+            continue
+        if dtype == np.float64 and not getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                "KAO201", name, 0,
+                f"concrete float64 aval in the {name} jaxpr "
+                f"({aval}); device paths are float32 end to end"))
+            break
+    for eqn in _walk_jaxpr(jaxpr):
+        prim = getattr(eqn.primitive, "name", "")
+        if any(cb in prim for cb in _CALLBACK_PRIMS):
+            findings.append(Finding(
+                "KAO201", name, 0,
+                f"host callback primitive '{prim}' in the {name} "
+                "hot path"))
+            break
+
+
+def _demo_instance():
+    from ..api import build_instance
+    from ..models.cluster import (
+        demo_assignment, demo_broker_list, demo_topology,
+    )
+
+    return build_instance(
+        demo_assignment(), demo_broker_list(), demo_topology()
+    )
+
+
+def _leaf_buffer_ids(tree) -> list[set]:
+    """Per-leaf sets of device-buffer identities (one per addressable
+    shard); two leaves sharing any identity alias one buffer."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ids = set()
+        for shard in getattr(leaf, "addressable_shards", []):
+            data = shard.data
+            ptr = getattr(data, "unsafe_buffer_pointer", None)
+            if callable(ptr):
+                try:
+                    ids.add(ptr())
+                    continue
+                except Exception:
+                    pass
+            ids.add(id(data))
+        out.append(ids)
+    return out
+
+
+def run_contracts(chains: int = 2, sweeps: int = 8) -> ContractReport:
+    """Trace the real solvers on the demo instance's bucket shape and
+    verify every static contract above. Returns a report whose
+    ``findings`` (KAO201/KAO202) merge into the lint output."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel import mesh as _mesh
+    from ..solvers.tpu import arrays, bucket
+    from ..solvers.tpu.anneal import make_solver_fn
+    from ..solvers.tpu.seed import greedy_seed
+    from ..solvers.tpu.sweep import (
+        make_lane_stepper_fn, make_sweep_stepper_fn,
+    )
+
+    findings: list = []
+    checks = 0
+    inst = _demo_instance()
+    bkt_p, bkt_r = bucket.bucket_shape(inst)
+    if bkt_p < inst.num_parts or bkt_r < inst.max_rf:
+        findings.append(Finding(
+            "KAO201", "bucket", 0,
+            f"bucket_shape({inst.num_parts}, {inst.max_rf}) returned a "
+            f"smaller shape ({bkt_p}, {bkt_r}); the ladder must only "
+            "pad up"))
+    m = arrays.from_instance(inst, num_parts=bkt_p, max_rf=bkt_r)
+    if tuple(m.a0.shape) != (bkt_p, bkt_r):
+        findings.append(Finding(
+            "KAO201", "arrays.from_instance", 0,
+            f"padded model shape {tuple(m.a0.shape)} != bucket shape "
+            f"({bkt_p}, {bkt_r})"))
+    seed = arrays.pad_candidate(
+        np.asarray(greedy_seed(inst), np.int32), m
+    )
+    key = jax.random.PRNGKey(0)
+    temps = arrays.geometric_temps(2.0, 0.02, sweeps)
+    mesh = _mesh.make_mesh(1)
+
+    # ---- sweep stepper: donation correspondence + jaxpr hygiene
+    state = _mesh.init_sweep_state(m, jnp.asarray(seed), key, mesh, chains)
+    shard_state = jax.tree.map(lambda x: x[0], state)  # one shard's view
+    stepper = make_sweep_stepper_fn(chains)
+    closed = jax.make_jaxpr(stepper)(m, shard_state, temps)
+    _check_jaxpr(closed, "sweep stepper", findings)
+    checks += 1
+    in_avals = [
+        (x.shape, str(x.dtype))
+        for x in jax.tree_util.tree_leaves(shard_state)
+    ]
+    n_state = len(in_avals)
+    out_avals = [
+        (tuple(v.aval.shape), str(v.aval.dtype))
+        for v in closed.jaxpr.outvars
+    ]
+    if out_avals[:n_state] != in_avals:
+        findings.append(Finding(
+            "KAO202", "sweep stepper", 0,
+            "carried state does not round-trip leaf-for-leaf "
+            f"(in {in_avals} vs out {out_avals[:n_state]}); "
+            "donate_argnums cannot update it in place"))
+    checks += 1
+    if len(out_avals) != n_state + 3:
+        # an arity regression is itself the contract violation — it
+        # must surface as a finding, never crash the checker
+        findings.append(Finding(
+            "KAO202", "sweep stepper", 0,
+            f"expected {n_state} state leaves + (best_a, best_k, "
+            f"curve) outputs, got {len(out_avals)} total"))
+        return ContractReport(findings=findings, checks_run=checks)
+    best_a_aval, best_k_aval, curve_aval = out_avals[n_state:]
+    if best_a_aval[0] != (bkt_p, bkt_r):
+        findings.append(Finding(
+            "KAO202", "sweep stepper", 0,
+            f"best_a shape {best_a_aval[0]} != bucket shape "
+            f"({bkt_p}, {bkt_r})"))
+    if curve_aval[0] != (sweeps,):
+        findings.append(Finding(
+            "KAO202", "sweep stepper", 0,
+            f"curve shape {curve_aval[0]} != (sweeps,)=({sweeps},)"))
+    checks += 1
+
+    # ---- init_sweep_state: donated leaves must be independent buffers
+    buf_ids = _leaf_buffer_ids(state)
+    for i in range(len(buf_ids)):
+        for j in range(i + 1, len(buf_ids)):
+            if buf_ids[i] & buf_ids[j]:
+                findings.append(Finding(
+                    "KAO202", "init_sweep_state", 0,
+                    f"state leaves {i} and {j} share a device buffer; "
+                    "donation would corrupt them in place (PR 4 bug "
+                    "class)"))
+    checks += 1
+
+    # ---- lane stepper (the batched path): same contracts, lane axis
+    L = 2
+    m_stack = arrays.stack_models([m, m])
+    lane_seeds = np.stack([seed, seed])
+    lane_keys = jax.random.split(key, L)
+    lane_state = _mesh.init_lane_state(
+        m_stack, lane_seeds, lane_keys, mesh, chains
+    )
+    lane_shard = jax.tree.map(lambda x: x[0], lane_state)
+    lane_stepper = make_lane_stepper_fn(chains)
+    closed_l = jax.make_jaxpr(lane_stepper)(m_stack, lane_shard, temps)
+    _check_jaxpr(closed_l, "lane stepper", findings)
+    checks += 1
+    lane_in = [
+        (x.shape, str(x.dtype))
+        for x in jax.tree_util.tree_leaves(lane_shard)
+    ]
+    lane_out = [
+        (tuple(v.aval.shape), str(v.aval.dtype))
+        for v in closed_l.jaxpr.outvars
+    ]
+    if lane_out[: len(lane_in)] != lane_in:
+        findings.append(Finding(
+            "KAO202", "lane stepper", 0,
+            "lane state does not round-trip leaf-for-leaf; lane "
+            "donation cannot update it in place"))
+    if len(lane_out) != len(lane_in) + 3:
+        findings.append(Finding(
+            "KAO202", "lane stepper", 0,
+            f"expected {len(lane_in)} state leaves + (best_a, best_k, "
+            f"curve) outputs, got {len(lane_out)} total"))
+        return ContractReport(findings=findings, checks_run=checks)
+    if lane_out[len(lane_in)][0] != (L, bkt_p, bkt_r):
+        findings.append(Finding(
+            "KAO202", "lane stepper", 0,
+            f"lane best_a shape {lane_out[len(lane_in)][0]} != "
+            f"({L}, {bkt_p}, {bkt_r})"))
+    checks += 1
+    lane_bufs = _leaf_buffer_ids(lane_state)
+    for i in range(len(lane_bufs)):
+        for j in range(i + 1, len(lane_bufs)):
+            if lane_bufs[i] & lane_bufs[j]:
+                findings.append(Finding(
+                    "KAO202", "init_lane_state", 0,
+                    f"lane state leaves {i} and {j} share a device "
+                    "buffer under donation"))
+    checks += 1
+
+    # ---- chain solver: jaxpr hygiene (stateless — no donation leg)
+    chain = make_solver_fn(chains, steps_per_round=4)
+    closed_c = jax.make_jaxpr(chain)(
+        m, jnp.asarray(seed), key, temps
+    )
+    _check_jaxpr(closed_c, "chain solver", findings)
+    chain_out = [tuple(v.aval.shape) for v in closed_c.jaxpr.outvars]
+    if not chain_out:
+        findings.append(Finding(
+            "KAO202", "chain solver", 0, "chain solver has no outputs"))
+        return ContractReport(findings=findings, checks_run=checks)
+    if chain_out[0] != (bkt_p, bkt_r):
+        findings.append(Finding(
+            "KAO202", "chain solver", 0,
+            f"chain best_a shape {chain_out[0]} != bucket shape"))
+    checks += 1
+
+    return ContractReport(findings=findings, checks_run=checks)

@@ -1,0 +1,551 @@
+"""AST lint pass — the project-specific JAX footgun rules.
+
+Every rule here encodes a bug class this repo actually shipped (and
+caught only dynamically, alignment- or platform-dependently):
+
+- **KAO101** donated-arg reuse: a value passed at a donated position of
+  a ``donate_argnums`` function is consumed by the dispatch; touching it
+  afterwards raises "buffer deleted" at runtime — on the lucky days.
+- **KAO102** shared broadcast base: two pytree leaves materialized from
+  one ``np.broadcast_to`` view can be zero-copied into ONE device
+  buffer, and under donation the in-place update corrupts both (the
+  exact PR 4 shape; ``np.array(view)`` per leaf is the fix).
+- **KAO103** float64-ambiguous numerics in device paths: float-literal
+  arrays without an explicit dtype default to float64 on host, and the
+  f64→f32 rounding at the device edge made the annealing ladder depend
+  on the host's float64 ``**`` (the PR 2 trajectory break).
+- **KAO104** PRNG key reuse: the same key fed to two consuming
+  ``jax.random`` calls yields correlated streams; keys must be
+  ``split``/``fold_in`` between uses.
+- **KAO105** Python ``if``/``while`` on traced values inside jit bodies
+  (or ``make_*`` solver-factory bodies): trace-time branching either
+  crashes (ConcretizationTypeError) or silently bakes one branch into
+  the executable.
+- **KAO106** bare ``print`` outside ``obs/log.py``: the serving path's
+  observability contract is structured key=value logs.
+- **KAO107** ``kao_*`` metric families emitted without ``# HELP`` +
+  ``# TYPE`` in the same module (the Prometheus exposition contract
+  tests/test_metrics_format.py pins).
+
+All rules are stdlib-``ast`` only and run in milliseconds over the whole
+package; precision is tuned so the CURRENT tree is clean (real findings
+were fixed, deliberate exceptions carry justified suppressions).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding, apply_suppressions, parse_suppressions
+
+# KAO103 applies only where arrays cross the host->device boundary; the
+# host-side exact oracles (models/, solvers/lp*, milp) legitimately run
+# scipy/LP math in float64.
+DEVICE_PATH_MARKERS = ("solvers/tpu", "ops", "parallel")
+
+# jax.random consumers that CONSUME a key (vs derive new keys from it)
+_KEY_DERIVERS = {
+    "split", "fold_in", "clone", "key_data", "wrap_key_data", "key_impl",
+}
+# jnp reductions whose appearance in an `if` test means a traced value
+# is being branched on
+_TRACED_REDUCERS = {"any", "all", "sum", "max", "min", "prod", "mean"}
+# attribute reads that are static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "aval"}
+
+# numpy constructors whose float-literal payloads default to float64
+_F64_CONSTRUCTORS = {"array", "asarray", "full", "linspace", "geomspace"}
+
+
+def _dotted(node: ast.AST) -> list[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; empty when not a plain chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _has_float_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+            return True
+    return False
+
+
+def _kw(call: ast.Call, name: str) -> ast.keyword | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+class _Parents(ast.NodeVisitor):
+    def __init__(self):
+        self.parent: dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parent[child] = node
+        super().generic_visit(node)
+
+
+def _walk_own_scope(fn):
+    """Walk a function's nodes in source order WITHOUT descending into
+    nested function definitions (each nested def gets its own pass)."""
+    queue = list(ast.iter_child_nodes(fn))
+    i = 0
+    while i < len(queue):
+        node = queue[i]
+        i += 1
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        queue.extend(ast.iter_child_nodes(node))
+
+
+def lint_source(
+    text: str, path: str, rel: str | None = None
+) -> list[Finding]:
+    """Lint one file's source; ``rel`` is the package-relative posix
+    path used for path-scoped rules (defaults to ``path``)."""
+    rel = (rel or path).replace("\\", "/")
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding("KAO100", path, e.lineno or 1,
+                        f"file does not parse: {e.msg}")]
+    parents = _Parents()
+    parents.visit(tree)
+    out: list[Finding] = []
+    out += _rule_print(tree, path, rel)
+    out += _rule_float64(tree, path, rel)
+    out += _rule_metrics_help_type(tree, path)
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        out += _rule_donated_reuse(fn, path)
+        out += _rule_broadcast_base(fn, path, parents.parent)
+        out += _rule_key_reuse(fn, path)
+    out += _rule_traced_branch(tree, path)
+    sup = parse_suppressions(text)
+    return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
+
+
+# ---------------------------------------------------------------- KAO106
+
+def _rule_print(tree, path, rel) -> list[Finding]:
+    if rel.endswith("obs/log.py"):
+        return []  # the structured logger's own emit site
+    return [
+        Finding("KAO106", path, n.lineno,
+                "bare print(); use obs.log (structured key=value lines) "
+                "or suppress where stdout IS the product")
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Name) and n.func.id == "print"
+    ]
+
+
+# ---------------------------------------------------------------- KAO103
+
+def _rule_float64(tree, path, rel) -> list[Finding]:
+    if not any(m in rel for m in DEVICE_PATH_MARKERS):
+        return []
+    out = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and n.attr in (
+            "float64", "float_",
+        ):
+            base = _dotted(n)
+            if base and base[0] in ("np", "numpy", "jnp"):
+                out.append(Finding(
+                    "KAO103", path, n.lineno,
+                    f"{'.'.join(base)} in a device path: the device "
+                    "consumes float32; build in float32 end to end "
+                    "(see arrays.geometric_temps)"))
+        if not isinstance(n, ast.Call):
+            continue
+        kw = _kw(n, "dtype")
+        if kw is not None and isinstance(kw.value, ast.Name) \
+                and kw.value.id == "float":
+            out.append(Finding(
+                "KAO103", path, n.lineno,
+                "dtype=float is float64 on host; name the width "
+                "explicitly (np.float32)"))
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "astype" \
+                and n.args and isinstance(n.args[0], ast.Name) \
+                and n.args[0].id == "float":
+            out.append(Finding(
+                "KAO103", path, n.lineno,
+                ".astype(float) is float64 on host; name the width "
+                "explicitly"))
+        # dtype-less constructors with float-literal payloads
+        chain = _dotted(n.func)
+        if (
+            len(chain) == 2
+            and chain[0] in ("np", "numpy")
+            and chain[1] in _F64_CONSTRUCTORS
+            and _kw(n, "dtype") is None
+            and n.args
+            and _has_float_literal(n.args[0] if chain[1] != "full"
+                                   else (n.args[1] if len(n.args) > 1
+                                         else n.args[0]))
+        ):
+            out.append(Finding(
+                "KAO103", path, n.lineno,
+                f"np.{chain[1]} with float literals and no dtype= "
+                "defaults to float64; pass dtype=np.float32 (device "
+                "paths must not depend on host float64)"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO101
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    kw = _kw(call, "donate_argnums")
+    if kw is None:
+        return None
+    v = kw.value
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        pos = tuple(
+            e.value for e in v.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+        return pos or None
+    return None  # dynamic spec: nothing to check statically
+
+
+def _stmts_in_order(body: list[ast.stmt]):
+    for st in body:
+        yield st
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue  # nested scopes get their own pass
+        for sub in (
+            getattr(st, "body", []), getattr(st, "orelse", []),
+            getattr(st, "finalbody", []),
+        ):
+            if isinstance(sub, list):
+                yield from _stmts_in_order(sub)
+        for h in getattr(st, "handlers", []):
+            yield from _stmts_in_order(h.body)
+
+
+def _rule_donated_reuse(fn, path) -> list[Finding]:
+    donators: dict[str, tuple[int, ...]] = {}
+    consumed: dict[str, int] = {}  # name -> line it was donated at
+    out = []
+    for st in _stmts_in_order(fn.body):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested scopes have their own pass
+        # loads of already-consumed names (checked before this
+        # statement's own stores rebind them)
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in consumed:
+                out.append(Finding(
+                    "KAO101", path, node.lineno,
+                    f"'{node.id}' was donated to a donate_argnums "
+                    f"dispatch at line {consumed[node.id]} and is dead; "
+                    "use the RETURNED state (in-place donation contract, "
+                    "docs/PIPELINE.md)"))
+                consumed.pop(node.id)  # one report per donation
+        # new donating wrappers: name = jax.jit(..., donate_argnums=...)
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(st.value, ast.Call):
+            pos = _donated_positions(st.value)
+            if pos is not None:
+                donators[st.targets[0].id] = pos
+        # consumption: a call of a known donating wrapper
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in donators:
+                for p in donators[node.func.id]:
+                    if p < len(node.args) \
+                            and isinstance(node.args[p], ast.Name):
+                        consumed[node.args[p].id] = node.lineno
+        # stores rebind (the returned state replacing the donated one)
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                consumed.pop(node.id, None)
+    return out
+
+
+# ---------------------------------------------------------------- KAO102
+
+_COPYING_CALLS = {"array", "copy", "ascontiguousarray", "asarray_chkfinite"}
+
+
+def _rule_broadcast_base(fn, path, parent) -> list[Finding]:
+    # HOST-side views only (np.broadcast_to): jnp.broadcast_to inside
+    # traced code is functional — it cannot alias two device_put'd
+    # pytree leaves to one buffer, which is the bug class here
+    bases: dict[str, int] = {}  # name -> assignment line
+    for node in _walk_own_scope(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            chain = _dotted(node.value.func)
+            if len(chain) == 2 and chain[0] in ("np", "numpy") \
+                    and chain[1] == "broadcast_to":
+                bases[node.targets[0].id] = node.lineno
+    if not bases:
+        return []
+    out = []
+    bare_uses: dict[str, int] = {}
+    for node in _walk_own_scope(fn):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in bases):
+            continue
+        p = parent.get(node)
+        # a use is SAFE when the view is immediately materialized into
+        # an independent buffer: np.array(view) / view.astype(...) /
+        # view.copy() / np.ascontiguousarray(view)
+        if isinstance(p, ast.Call) and p.args and p.args[0] is node:
+            chain = _dotted(p.func)
+            if len(chain) == 2 and chain[0] in ("np", "numpy", "jnp") \
+                    and chain[1] in _COPYING_CALLS:
+                continue
+        if isinstance(p, ast.Attribute) and p.attr in ("astype", "copy"):
+            continue
+        bare_uses[node.id] = bare_uses.get(node.id, 0) + 1
+        if bare_uses[node.id] == 2:
+            out.append(Finding(
+                "KAO102", path, node.lineno,
+                f"'{node.id}' is a broadcast VIEW used as more than one "
+                "leaf: device_put can zero-copy both into ONE buffer, "
+                "and donation then corrupts them in place (PR 4 bug "
+                "class); materialize each leaf with np.array(view)"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO104
+
+def _rule_key_reuse(fn, path) -> list[Finding]:
+    keys: set[str] = set()
+    uses: dict[str, int] = {}
+    out = []
+    for st in _stmts_in_order(fn.body):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(st):
+            if isinstance(node, ast.Assign):
+                tgts = node.targets
+                val = node.value
+                is_key_src = False
+                if isinstance(val, ast.Call):
+                    chain = _dotted(val.func)
+                    if chain and chain[-1] in ("PRNGKey", "key") \
+                            and "random" in chain:
+                        is_key_src = True
+                    if chain and chain[-1] in ("split", "fold_in") \
+                            and "random" in chain:
+                        is_key_src = True
+                for t in tgts:
+                    names = (
+                        [t] if isinstance(t, ast.Name)
+                        else [e for e in getattr(t, "elts", [])
+                              if isinstance(e, ast.Name)]
+                    )
+                    for nm in names:
+                        if is_key_src:
+                            keys.add(nm.id)
+                        uses.pop(nm.id, None)  # any rebind resets
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                if not (chain and "random" in chain
+                        and chain[-1] not in _KEY_DERIVERS
+                        and chain[-1] not in ("PRNGKey", "key")):
+                    continue
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in keys:
+                        uses[a.id] = uses.get(a.id, 0) + 1
+                        if uses[a.id] == 2:
+                            out.append(Finding(
+                                "KAO104", path, node.lineno,
+                                f"PRNG key '{a.id}' consumed by a second "
+                                "jax.random call without split/fold_in: "
+                                "the streams are identical, not "
+                                "independent"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO105
+
+def _jitted_names(tree) -> set[str]:
+    """Names referenced anywhere inside a ``jax.jit(...)`` call: those
+    functions' bodies are traced."""
+    names: set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            chain = _dotted(n.func)
+            if chain and chain[-1] == "jit":
+                for a in ast.walk(n):
+                    if isinstance(a, ast.Name) \
+                            and isinstance(a.ctx, ast.Load):
+                        names.add(a.id)
+    return names
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        chain = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+        if chain and chain[-1] == "jit":
+            return True
+        if isinstance(dec, ast.Call) and _dotted(dec.func)[-1:] == [
+            "partial"
+        ]:
+            for a in dec.args:
+                if _dotted(a)[-1:] == ["jit"]:
+                    return True
+    return False
+
+
+def _traced_fns(tree):
+    jitted = _jitted_names(tree)
+    for n in ast.walk(tree):
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _is_jit_decorated(n) or n.name in jitted:
+            yield n
+            continue
+        # nested defs inside a make_* solver factory are the functions
+        # the factory returns for jit/vmap/shard_map hosting
+        for inner in ast.walk(n):
+            if inner is n:
+                continue
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name.lstrip("_").startswith("make"):
+                yield inner
+
+
+def _test_touches_traced(test: ast.expr, params: set[str]) -> bool:
+    """True when an ``if``/``while`` test reads a traced parameter in a
+    way that needs a concrete value at trace time."""
+
+    def visit(node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in params
+        if isinstance(node, ast.BoolOp):
+            return any(visit(v) for v in node.values)
+        if isinstance(node, ast.UnaryOp):
+            return visit(node.operand)
+        if isinstance(node, ast.BinOp):
+            return visit(node.left) or visit(node.right)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a static structure test
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return any(visit(v) for v in
+                       [node.left, *node.comparators])
+        if isinstance(node, ast.Subscript):
+            return visit(node.value)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False  # shapes/dtypes are static at trace time
+            return False  # other attribute reads: conservative skip
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            # jnp reductions of traced values inside a Python branch
+            # are the classic ConcretizationTypeError
+            if len(chain) >= 2 and chain[0] in ("jnp", "jax") \
+                    and chain[-1] in _TRACED_REDUCERS:
+                return any(visit(a) for a in node.args)
+            return False  # len(), isinstance(), helpers: static/opaque
+        return False
+
+    return visit(test)
+
+
+def _rule_traced_branch(tree, path) -> list[Finding]:
+    out = []
+    seen: set[int] = set()
+    for fn in _traced_fns(tree):
+        params = {
+            a.arg for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            ) if a.arg != "self"
+        }
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and node.lineno not in seen \
+                    and _test_touches_traced(node.test, params):
+                seen.add(node.lineno)
+                kind = "while" if isinstance(node, ast.While) else "if"
+                out.append(Finding(
+                    "KAO105", path, node.lineno,
+                    f"Python `{kind}` on a traced value inside a "
+                    "jit/solver-factory body; use jnp.where / "
+                    "lax.cond / lax.while_loop"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO107
+
+def _string_literals(tree):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.lineno, n.value
+        elif isinstance(n, ast.JoinedStr):
+            for v in n.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    yield n.lineno, v.value
+
+
+_FAMILY_RE = re.compile(r"^kao_[a-z0-9_]+$")
+
+
+def _family(sample: str) -> str:
+    name = sample.split("{")[0].split()[0]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    return name
+
+
+def _rule_metrics_help_type(tree, path) -> list[Finding]:
+    emitted: dict[str, int] = {}
+    documented: dict[str, set[str]] = {}
+    for lineno, s in _string_literals(tree):
+        stripped = s.lstrip()
+        if stripped.startswith("# HELP ") or stripped.startswith("# TYPE "):
+            kind = stripped.split()[1]
+            rest = stripped.split()[2:]
+            if rest and rest[0].startswith("kao_"):
+                documented.setdefault(_family(rest[0]), set()).add(kind)
+        elif stripped.startswith("kao_"):
+            # only exposition-shaped literals count as emission: the
+            # family name must be followed by a label brace, or by
+            # nothing but whitespace (an f-string sample prefix like
+            # "kao_x " with the value interpolated). A bare "kao_foo"
+            # (contextvar names, .so basenames) or prose containing
+            # the name is not a metric sample.
+            head = stripped.split("{")[0].split()[0]
+            rest = stripped[len(head):]
+            if not _FAMILY_RE.match(_family(head)):
+                continue
+            if not (rest.startswith("{")
+                    or (rest != "" and rest.strip() == "")):
+                continue
+            emitted.setdefault(_family(head), lineno)
+    return [
+        Finding("KAO107", path, line,
+                f"metric family '{fam}' emitted without # HELP and "
+                "# TYPE in this module (Prometheus exposition "
+                "contract, tests/test_metrics_format.py)")
+        for fam, line in sorted(emitted.items(), key=lambda kv: kv[1])
+        if documented.get(fam, set()) != {"HELP", "TYPE"}
+    ]

@@ -1,0 +1,265 @@
+"""Runtime sanitizer mode (``KAO_SANITIZE=1`` / ``--sanitize``).
+
+The static passes (rules_ast, contracts) catch the footgun *patterns*;
+this module catches the runtime *symptoms* on a live solve, at the three
+chokepoints the shipped bugs actually passed through:
+
+- **NaN aborts** — ``jax.config.jax_debug_nans`` is enabled so the
+  first NaN produced on device raises at its dispatch instead of
+  corrupting a trajectory silently; the engine routes the resulting
+  ``FloatingPointError`` through :func:`note_nan_abort` so the event is
+  counted on ``/metrics`` (``kao_sanitizer_nan_aborts_total``) before it
+  propagates. :func:`check_host` gives host-built float arrays (the
+  annealing temperature ladder) the same guard.
+- **Recompile sentinel** — ``jax.config.jax_log_compiles`` is enabled
+  (every compile becomes a visible log line) and a logging handler on
+  jax's loggers feeds :func:`note_compile`; ``parallel.mesh`` calls it
+  directly at its AOT compile site with the executable-cache key. A
+  (solver, shape-signature) key compiling more than
+  ``KAO_SANITIZE_COMPILE_BUDGET`` times (default 2: the legitimate
+  maximum — one Pallas attempt plus one XLA fallback) means executable
+  thrash — the exact failure the shape-bucketed cache exists to prevent
+  — and FAILS the solve (``kao_sanitizer_recompiles_total``).
+- **Donation use-after-free guard** — ``parallel.mesh._dispatch``
+  refuses to dispatch arguments that were already consumed by a
+  donating dispatch, raising :class:`DonationReuseError` with the
+  cache key instead of XLA's "buffer deleted" deep in the runtime
+  (``kao_sanitizer_donation_reuse_total``).
+
+Everything is a no-op until :func:`enable` runs (or ``KAO_SANITIZE`` is
+truthy at import); the guards add one predicate call per dispatch when
+off. Counters are process-wide, thread-safe, and rendered with
+HELP/TYPE by ``serve.render_metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+__all__ = [
+    "SanitizerError", "RecompileBudgetError", "DonationReuseError",
+    "enabled", "enable", "disable", "install", "compile_budget",
+    "note_compile", "forget_key", "note_nan_abort", "note_nan_abort_once",
+    "note_donation_reuse",
+    "check_host", "snapshot", "reset",
+]
+
+
+class SanitizerError(RuntimeError):
+    """Base class: a sanitizer tripwire fired."""
+
+
+class RecompileBudgetError(SanitizerError):
+    pass
+
+
+class DonationReuseError(SanitizerError):
+    pass
+
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "on", "true", "yes")
+
+
+_LOCK = threading.Lock()
+_ENABLED = _env_truthy("KAO_SANITIZE")
+_INSTALLED = False
+_COMPILES_BY_KEY: dict = {}
+_C = {
+    "recompiles_total": 0,       # sentinel trips (budget exceeded)
+    "nan_aborts_total": 0,       # NaN guard aborts (device or host)
+    "donation_reuse_total": 0,   # use-after-free guard trips
+    "compiles_observed_total": 0,   # real AOT compiles (note_compile)
+    "compile_log_lines_total": 0,   # jax_log_compiles lines seen (the
+                                    # log listener; several per compile)
+}
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def compile_budget() -> int:
+    """Expected compiles per executable-cache key: 1 normal + 1 for a
+    legitimate Pallas->XLA fallback recompile."""
+    try:
+        return int(os.environ.get("KAO_SANITIZE_COMPILE_BUDGET", "2"))
+    except ValueError:
+        return 2
+
+
+class _CompileLogHandler(logging.Handler):
+    """Counts jax's log_compiles records — the operator-visible side of
+    the sentinel (the authoritative per-key budget is fed directly by
+    parallel.mesh at its compile site)."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if "compil" in msg.lower():
+            # separate counter from compiles_observed_total: a single
+            # compile emits several matching log lines, and the mesh
+            # compile site already feeds the authoritative count
+            with _LOCK:
+                _C["compile_log_lines_total"] += 1
+
+
+_LOG_HANDLER = _CompileLogHandler()
+# the compile log lines come from jax._src.dispatch (jit) and
+# jax._src.interpreters.pxla (sharded computations); both propagate to
+# the "jax" root logger
+_JAX_LOGGERS = ("jax._src.dispatch", "jax._src.interpreters.pxla")
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+    install()
+
+
+def disable() -> None:
+    """Turn the guards off (tests); the jax config flags are reverted."""
+    global _ENABLED, _INSTALLED
+    _ENABLED = False
+    if _INSTALLED:
+        try:
+            import jax
+
+            jax.config.update("jax_debug_nans", False)
+            jax.config.update("jax_log_compiles", False)
+        except Exception:
+            pass
+        for name in _JAX_LOGGERS:
+            logging.getLogger(name).removeHandler(_LOG_HANDLER)
+        _INSTALLED = False
+
+
+def install() -> None:
+    """Idempotently flip the jax debug config + attach the compile-log
+    listener. Called by the engine/serve entry points when the
+    sanitizer is enabled; safe before or after backend init."""
+    global _INSTALLED
+    if not _ENABLED or _INSTALLED:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+        jax.config.update("jax_log_compiles", True)
+    except Exception:
+        pass  # sanitizer must never be the reason a solve cannot start
+    for name in _JAX_LOGGERS:
+        logging.getLogger(name).addHandler(_LOG_HANDLER)
+    _INSTALLED = True
+
+
+def note_compile(key) -> None:
+    """Record one real XLA compile for an executable-cache key; raises
+    :class:`RecompileBudgetError` past the per-key budget."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _C["compiles_observed_total"] += 1
+        n = _COMPILES_BY_KEY.get(key, 0) + 1
+        _COMPILES_BY_KEY[key] = n
+        budget = compile_budget()
+        if n <= budget:
+            return
+        _C["recompiles_total"] += 1
+        # the trip ends this thrash episode: reset the key so the NEXT
+        # request's cold rebuild is legitimate (without this, a tripped
+        # key would recompile-and-trip on every later request — the
+        # executable was never cached, so the count must not persist)
+        _COMPILES_BY_KEY.pop(key, None)
+    from ..obs import log as _olog
+
+    _olog.error("sanitizer_recompile_budget", key=repr(key)[:200],
+                compiles=n, budget=budget)
+    raise RecompileBudgetError(
+        f"sanitizer: executable key compiled {n}x (budget {budget}); "
+        "shape-bucket thrash — same-bucket solves must reuse one "
+        f"executable. key={key!r}"
+    )
+
+
+def forget_key(key) -> None:
+    """The executable cache evicted this key: its NEXT compile is a
+    legitimate cold rebuild, not thrash — reset the sentinel's count
+    (otherwise a long-lived sanitized service whose traffic spans more
+    bucket keys than the LRU holds would fail healthy solves)."""
+    with _LOCK:
+        _COMPILES_BY_KEY.pop(key, None)
+
+
+def note_nan_abort_once(exc: BaseException, context: str = "") -> None:
+    """Count a NaN abort exactly once per exception object: nested
+    solve paths (batch sequential fallback, the chain-engine retry)
+    route the SAME FloatingPointError through several handlers."""
+    if getattr(exc, "_kao_nan_counted", False):
+        return
+    try:
+        exc._kao_nan_counted = True
+    except Exception:
+        pass
+    note_nan_abort(context)
+
+
+def note_nan_abort(context: str = "") -> None:
+    if not _ENABLED:
+        # a host-side FloatingPointError can reach the engine's
+        # handlers without the sanitizer armed (numpy errstate etc.);
+        # the counter must stay zero-and-inert when off
+        return
+    with _LOCK:
+        _C["nan_aborts_total"] += 1
+    from ..obs import log as _olog
+
+    _olog.error("sanitizer_nan_abort", context=context or None)
+
+
+def note_donation_reuse(key) -> None:
+    with _LOCK:
+        _C["donation_reuse_total"] += 1
+    from ..obs import log as _olog
+
+    _olog.error("sanitizer_donation_reuse", key=repr(key)[:200])
+    raise DonationReuseError(
+        "sanitizer: dispatch arguments were already consumed by a "
+        "donating dispatch (use the RETURNED state — in-place donation "
+        f"contract, docs/PIPELINE.md). key={key!r}"
+    )
+
+
+def check_host(arr, context: str = "host array") -> None:
+    """NaN guard for host-built float arrays (e.g. the temperature
+    ladder) — the device-side jax_debug_nans cannot see these until
+    they have already steered a trajectory."""
+    if not _ENABLED:
+        return
+    import numpy as np
+
+    a = np.asarray(arr)
+    if a.dtype.kind == "f" and not np.isfinite(a).all():
+        note_nan_abort(context)
+        raise SanitizerError(
+            f"sanitizer: non-finite values in {context}"
+        )
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        out = dict(_C)
+    out["enabled"] = int(_ENABLED)
+    return out
+
+
+def reset() -> None:
+    """Zero the counters and per-key compile history (tests)."""
+    with _LOCK:
+        _COMPILES_BY_KEY.clear()
+        for k in _C:
+            _C[k] = 0

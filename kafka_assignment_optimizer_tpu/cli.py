@@ -107,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
         "output, README.md:65-91)",
     )
     ap.add_argument(
+        "--sanitize",
+        action="store_true",
+        help="runtime sanitizer mode (same as KAO_SANITIZE=1; see "
+        "docs/ANALYSIS.md): jax_debug_nans, a recompile sentinel on "
+        "the executable cache, and a donation use-after-free guard — "
+        "trips fail the solve loudly instead of corrupting it quietly",
+    )
+    ap.add_argument(
         "--distributed",
         action="store_true",
         help="initialize jax's multi-host runtime before solving. Run "
@@ -164,14 +172,20 @@ def main(argv: list[str] | None = None) -> int:
         return _run(build_parser().parse_args(argv))
     except (ValueError, KeyError, FileNotFoundError, RuntimeError, OSError) as e:
         msg = e.args[0] if e.args and isinstance(e.args[0], str) else e
+        # kao: disable=KAO106 -- "error: ..." on stderr is the CLI's UX contract
         print(f"error: {msg}", file=sys.stderr)
         return 2
     except json.JSONDecodeError as e:
+        # kao: disable=KAO106 -- "error: ..." on stderr is the CLI's UX contract
         print(f"error: invalid JSON input: {e}", file=sys.stderr)
         return 2
 
 
 def _run(args: argparse.Namespace) -> int:
+    if args.sanitize:
+        from .analysis import sanitize as _sanitize
+
+        _sanitize.enable()
     if args.distributed:
         from .parallel.distributed import init_distributed
 
@@ -197,6 +211,7 @@ def _run(args: argparse.Namespace) -> int:
         if args.output:
             Path(args.output).write_text(out + "\n")
         else:
+            # kao: disable=KAO106 -- the report JSON on stdout IS the product
             print(out)
         return 0 if rep["feasible"] else 3
 
@@ -238,11 +253,13 @@ def _run(args: argparse.Namespace) -> int:
     if args.output:
         Path(args.output).write_text(out + "\n")
     else:
+        # kao: disable=KAO106 -- the plan JSON on stdout IS the product
         print(out)
     rep = res.report()
     if args.trace and "solve_report" in res.solve.stats:
         rep["solve_report"] = res.solve.stats["solve_report"]
     if args.report or args.trace:
+        # kao: disable=KAO106 -- --report's stderr JSON is the CLI's UX contract
         print(json.dumps(rep, indent=2, default=str), file=sys.stderr)
     return 0 if rep["feasible"] else 3
 

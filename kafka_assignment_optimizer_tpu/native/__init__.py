@@ -52,7 +52,9 @@ def _compile(src: Path, out: Path, extra_flags: list[str],
                 f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}"
             )
         if verbose and proc.stderr:
-            print(proc.stderr)
+            from ..obs import log as _olog
+
+            _olog.warn("native_build_warnings", stderr=proc.stderr)
         os.replace(tmp, out)  # atomic publish
     return out
 

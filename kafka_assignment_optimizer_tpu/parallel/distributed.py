@@ -39,7 +39,8 @@ N workers silently solving alone is worse than an error.
 from __future__ import annotations
 
 import os
-import sys
+
+from ..obs import log as _olog
 
 
 def init_distributed(
@@ -81,10 +82,9 @@ def init_distributed(
         # launch to run locally.
         if explicit:
             raise
-        print(
-            "[kao] --distributed: no cluster environment detected; "
-            "continuing single-host",
-            file=sys.stderr,
+        _olog.warn(
+            "distributed_single_host",
+            reason="no cluster environment detected",
         )
     except RuntimeError:
         # the XLA backend is already initialized (initialize() must
@@ -94,9 +94,8 @@ def init_distributed(
         # degrade into N workers silently solving alone.
         if explicit or jax.process_count() > 1:
             raise
-        print(
-            "[kao] --distributed: XLA backend already initialized; "
-            "continuing single-host",
-            file=sys.stderr,
+        _olog.warn(
+            "distributed_single_host",
+            reason="XLA backend already initialized",
         )
     return jax.process_index(), jax.process_count()

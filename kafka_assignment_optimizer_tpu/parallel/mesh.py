@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..analysis import sanitize as _san
 from ..obs import trace as _otrace
 from ..solvers.tpu.arrays import ModelArrays
 from ..solvers.tpu.bucket import STATS as _CACHE_STATS
@@ -99,7 +100,10 @@ def clear_exec_cache() -> None:
     """Drop the AOT executable LRU (long-lived services pair this with
     ``jax.clear_caches()`` maintenance)."""
     with _EXECUTABLES_LOCK:
+        dropped = list(_EXECUTABLES)
         _EXECUTABLES.clear()
+    for key in dropped:
+        _san.forget_key(key)  # post-clear compiles are cold, not thrash
 
 
 def _arg_signature(args) -> tuple:
@@ -147,6 +151,11 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
     dispatch if the AOT path fails (version quirks, sharding mismatch) —
     correctness never depends on the cache."""
     key = (solver_key, _arg_signature(args))
+    if _san.enabled() and not _args_alive(args):
+        # sanitizer donation guard: refuse to dispatch a state that a
+        # donating dispatch already consumed — a clear error here beats
+        # XLA's "buffer deleted" deep in the runtime (raises)
+        _san.note_donation_reuse(key)
     while True:
         with _EXECUTABLES_LOCK:
             ex = _EXECUTABLES.get(key)
@@ -166,6 +175,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             except Exception:
                 with _EXECUTABLES_LOCK:
                     _EXECUTABLES.pop(key, None)
+                _san.forget_key(key)  # its next compile is a rebuild
                 if not _args_alive(args):
                     # a donating executable consumed its buffers before
                     # failing — the jit retry cannot run on dead args
@@ -188,8 +198,14 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
         try:
             with _otrace.span("compile"):
                 ex = _lower_and_compile(fn, args)
+            # recompile sentinel (analysis.sanitize): a key compiling
+            # past its budget means executable thrash — fail the solve
+            # rather than paying 26-68 s per request silently
+            _san.note_compile(key)
             with _otrace.span("dispatch", cache="miss"):
                 out = ex(*args)
+        except _san.SanitizerError:
+            raise  # a tripped sentinel must fail the solve, not fall back
         except Exception:
             if not _args_alive(args):
                 raise
@@ -197,10 +213,15 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
         _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
+        evicted = []
         with _EXECUTABLES_LOCK:
             _EXECUTABLES[key] = ex
             while len(_EXECUTABLES) > _EXECUTABLES_MAX:
-                _EXECUTABLES.popitem(last=False)
+                evicted.append(_EXECUTABLES.popitem(last=False)[0])
+        for old in evicted:
+            # LRU eviction makes the key's next compile legitimate —
+            # the sanitizer's recompile sentinel must not count it
+            _san.forget_key(old)
         return out
     finally:
         with _EXECUTABLES_LOCK:

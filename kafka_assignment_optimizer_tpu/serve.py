@@ -106,6 +106,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import landing
+from .analysis import sanitize as _sanitize_mod
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
 from .obs import log as _olog
@@ -440,6 +441,10 @@ def render_metrics() -> str:
             snap[f"queue_{k}"] = v
     except Exception:
         pass
+    # runtime sanitizer counters (analysis.sanitize): zero and inert
+    # unless KAO_SANITIZE / --sanitize armed the guards
+    for k, v in _sanitize_mod.snapshot().items():
+        snap[f"sanitizer_{k}"] = v
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
@@ -993,6 +998,7 @@ def handle_healthz() -> dict:
             "report_ring_capacity": _otrace.RECENT.capacity,
             "profile_dir": OBS["profile_dir"],
         },
+        "sanitizer": _sanitize_mod.snapshot(),
     }
 
 
@@ -1375,6 +1381,14 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="N",
                     help="profiled solves per bucket with "
                          "--profile-dir (default 1)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="runtime sanitizer mode (same as "
+                         "KAO_SANITIZE=1; docs/ANALYSIS.md): "
+                         "jax_debug_nans, a recompile sentinel over "
+                         "the executable cache, and a donation "
+                         "use-after-free guard; trips are counted on "
+                         "/metrics (kao_sanitizer_*) and fail the "
+                         "offending solve")
     args = ap.parse_args(argv)
     if args.lock_wait_s < 0:
         ap.error("--lock-wait-s must be >= 0")
@@ -1403,6 +1417,10 @@ def main(argv: list[str] | None = None) -> int:
     from .utils.platform import pin_platform
 
     pin_platform()
+    if args.sanitize:
+        from .analysis import sanitize as _sanitize
+
+        _sanitize.enable()
     if args.no_pipeline:
         from .solvers.tpu.engine import set_pipeline_default
 

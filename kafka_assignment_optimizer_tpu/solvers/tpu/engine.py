@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis import sanitize as _san
 from ...models import instance as _instance_mod
 from ...models.instance import ProblemInstance
 from ...obs import log as _olog
@@ -208,7 +209,14 @@ def solve_tpu(inst: ProblemInstance, *args,
     so the response can echo it."""
     tr = _otrace.begin(trace, name="solve_tpu")
     if tr is None:
-        res = _solve_tpu(inst, *args, **kwargs)
+        try:
+            res = _solve_tpu(inst, *args, **kwargs)
+        except FloatingPointError as e:
+            # jax_debug_nans (sanitizer mode) surfaces device NaNs as
+            # FloatingPointError at dispatch — count before propagating
+            # (once per exception: nested solves share the object)
+            _san.note_nan_abort_once(e, "solve_tpu")
+            raise
         tid = _otrace.current_trace_id()
         if tid:
             res.stats.setdefault("trace_id", tid)
@@ -216,6 +224,8 @@ def solve_tpu(inst: ProblemInstance, *args,
     try:
         res = _solve_tpu(inst, *args, **kwargs)
     except BaseException as e:
+        if isinstance(e, FloatingPointError):
+            _san.note_nan_abort_once(e, "solve_tpu")
         tr.root.set(error=repr(e)[:200])
         _otrace.finish(tr)
         raise
@@ -247,6 +257,10 @@ def _solve_tpu(
     # double-buffered ladder dispatch (docs/PIPELINE.md): None defers
     # to the process default (--no-pipeline / KAO_NO_PIPELINE flip it)
     pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
+    if _san.enabled():
+        # sanitizer mode (KAO_SANITIZE=1): debug_nans + log_compiles +
+        # the recompile sentinel / donation guard in parallel.mesh
+        _san.install()
     from ...utils.platform import enable_compile_cache, ensure_backend
 
     # a previous solve on this instance may have cancelled straggling
@@ -1141,6 +1155,9 @@ def _build_chunks(inst, engine, rounds, t_hi, t_lo, time_limit_s):
     from .arrays import geometric_temps
 
     temps_full = geometric_temps(t_hi, t_lo, rounds)
+    # host-built floats steer every accept decision; the device-side
+    # NaN guard cannot see them until a trajectory is already wrong
+    _san.check_host(temps_full, "temperature ladder")
     if engine == "sweep":
         n_chunks = (
             8 if (time_limit_s is not None or inst.num_parts >= 20_000)
@@ -1777,6 +1794,8 @@ def solve_tpu_batch(
     the process default."""
     t0 = time.perf_counter()
     pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
+    if _san.enabled():
+        _san.install()
     if not insts:
         return []
     if isinstance(seeds, int):
@@ -1826,6 +1845,8 @@ def solve_tpu_batch(
                 enable_compile_cache, ensure_backend, bucket, pipeline,
             )
     except BaseException as e:
+        if isinstance(e, FloatingPointError):
+            _san.note_nan_abort_once(e, "solve_tpu_batch")
         if tr is not None:
             tr.root.set(error=repr(e)[:200])
             _otrace.finish(tr)

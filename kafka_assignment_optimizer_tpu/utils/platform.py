@@ -49,9 +49,9 @@ def enable_compile_cache() -> None:
         except OSError as e:
             # the cache is an optimization, never a precondition: a
             # read-only $HOME (containerized service) must not fail solves
-            import sys
+            from ..obs import log as _olog
 
-            print(f"[kao] compile cache disabled ({e})", file=sys.stderr)
+            _olog.warn("compile_cache_disabled", error=str(e))
             return
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,496 @@
+"""kao-check — the static-analysis suite's own test coverage.
+
+Three layers (docs/ANALYSIS.md):
+
+- per-rule fixtures: one positive (must flag) and one negative (must
+  stay silent) snippet per AST rule, run through ``lint_source``;
+- jaxpr contracts: the checker passes on the REAL sweep/lane/chain
+  solvers and detects seeded violations (float64, host callbacks);
+- self-check: ``python -m kafka_assignment_optimizer_tpu.analysis``
+  exits 0 on the repo's own package tree and non-zero on a fixture
+  violation — the property CI enforces.
+
+Plus the runtime sanitizer's counters/guards and their /metrics
+exposition.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.analysis import lint_paths
+from kafka_assignment_optimizer_tpu.analysis.rules_ast import lint_source
+
+
+def _lint(snippet: str, rel: str = "solvers/tpu/fixture.py"):
+    return lint_source(textwrap.dedent(snippet), "fixture.py", rel=rel)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- KAO101
+
+POS_101 = """
+    import jax
+
+    def run(m, state, temps):
+        f = jax.jit(step, donate_argnums=(1,))
+        out = f(m, state, temps)
+        return state[0]  # donated: dead buffer
+"""
+
+NEG_101 = """
+    import jax
+
+    def run(m, state, temps):
+        f = jax.jit(step, donate_argnums=(1,))
+        state, best = f(m, state, temps)  # rebinds to the RETURNED state
+        return state[0]
+"""
+
+
+def test_kao101_donated_reuse():
+    assert "KAO101" in _rules(_lint(POS_101))
+    assert "KAO101" not in _rules(_lint(NEG_101))
+
+
+# ---------------------------------------------------------------- KAO102
+
+POS_102 = """
+    import numpy as np
+
+    def init(seed, n):
+        tile = np.broadcast_to(seed, (n, 4, 4))
+        return (tile, np.zeros(n), tile)  # two leaves, ONE base buffer
+"""
+
+NEG_102 = """
+    import numpy as np
+
+    def init(seed, n):
+        tile = np.broadcast_to(seed, (n, 4, 4))
+        return (np.array(tile), np.zeros(n), np.array(tile))
+"""
+
+NEG_102_JNP = """
+    import jax.numpy as jnp
+
+    def traced(a, n):
+        x = jnp.broadcast_to(a, (n, 4))
+        return x + x  # functional device op: no host buffer aliasing
+"""
+
+
+def test_kao102_shared_broadcast_base():
+    assert "KAO102" in _rules(_lint(POS_102))
+    assert "KAO102" not in _rules(_lint(NEG_102))
+    assert "KAO102" not in _rules(_lint(NEG_102_JNP))
+
+
+# ---------------------------------------------------------------- KAO103
+
+POS_103 = """
+    import numpy as np
+
+    def ladder(n):
+        return np.array([2.0, 1.0, 0.5])  # float64 on host
+"""
+
+POS_103_DTYPE = """
+    import numpy as np
+
+    def ladder(n):
+        return np.zeros(n, dtype=float)
+"""
+
+NEG_103 = """
+    import numpy as np
+
+    def ladder(n):
+        return np.array([2.0, 1.0, 0.5], dtype=np.float32)
+"""
+
+
+def test_kao103_float64_in_device_path():
+    assert "KAO103" in _rules(_lint(POS_103))
+    assert "KAO103" in _rules(_lint(POS_103_DTYPE))
+    assert "KAO103" not in _rules(_lint(NEG_103))
+    # host-side oracle paths are out of scope: float64 LP math is fine
+    assert "KAO103" not in _rules(
+        _lint(POS_103, rel="models/bounds.py")
+    )
+
+
+# ---------------------------------------------------------------- KAO104
+
+POS_104 = """
+    import jax
+
+    def sample(n):
+        key = jax.random.PRNGKey(0)
+        a = jax.random.randint(key, (n,), 0, 4)
+        b = jax.random.randint(key, (n,), 0, 4)  # identical stream!
+        return a, b
+"""
+
+NEG_104 = """
+    import jax
+
+    def sample(n):
+        key = jax.random.PRNGKey(0)
+        ka, kb = jax.random.split(key)
+        a = jax.random.randint(ka, (n,), 0, 4)
+        b = jax.random.randint(kb, (n,), 0, 4)
+        return a, b
+"""
+
+
+def test_kao104_key_reuse():
+    assert "KAO104" in _rules(_lint(POS_104))
+    assert "KAO104" not in _rules(_lint(NEG_104))
+
+
+# ---------------------------------------------------------------- KAO105
+
+POS_105 = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(a, t):
+        if jnp.any(a > t):  # traced value in a Python branch
+            return a - 1
+        return a
+"""
+
+POS_105_FACTORY = """
+    def make_solver_fn(n):
+        def solve(m, a, temps):
+            while a > 0:  # traced param in a Python loop
+                a = a - 1
+            return a
+        return solve
+"""
+
+NEG_105 = """
+    import jax
+
+    @jax.jit
+    def step(a, t, axis_name=None):
+        if axis_name is None:  # static structure test
+            return a
+        if a.shape[0] > 2:  # shapes are static at trace time
+            return a + t
+        return a
+"""
+
+
+def test_kao105_traced_branch():
+    assert "KAO105" in _rules(_lint(POS_105))
+    assert "KAO105" in _rules(_lint(POS_105_FACTORY))
+    assert "KAO105" not in _rules(_lint(NEG_105))
+
+
+# ---------------------------------------------------------------- KAO106
+
+POS_106 = """
+    def handle(req):
+        print("served", req)
+"""
+
+NEG_106_LOG = """
+    from .obs import log as _olog
+
+    def handle(req):
+        _olog.info("served", req=req)
+"""
+
+
+def test_kao106_bare_print():
+    assert "KAO106" in _rules(_lint(POS_106))
+    assert "KAO106" not in _rules(_lint(NEG_106_LOG))
+    # the structured logger's own emit site is the one allowed print
+    assert "KAO106" not in _rules(
+        _lint("def emit(line):\n    print(line)\n", rel="obs/log.py")
+    )
+
+
+# ---------------------------------------------------------------- KAO107
+
+POS_107 = """
+    def render(n):
+        lines = []
+        lines.append(f"kao_new_counter_total {n}")
+        return lines
+"""
+
+NEG_107 = """
+    def render(n):
+        lines = []
+        lines.append("# HELP kao_new_counter_total new counter")
+        lines.append("# TYPE kao_new_counter_total counter")
+        lines.append(f"kao_new_counter_total {n}")
+        return lines
+"""
+
+NEG_107_PROSE = """
+    NAME = "kao_current_span"  # a contextvar name, not a metric sample
+"""
+
+
+def test_kao107_metrics_help_type():
+    assert "KAO107" in _rules(_lint(POS_107))
+    assert "KAO107" not in _rules(_lint(NEG_107))
+    assert "KAO107" not in _rules(_lint(NEG_107_PROSE))
+
+
+# ------------------------------------------------------------ suppression
+
+def test_suppression_requires_justification():
+    sup = 'def f(x):\n    print(x)  # kao: disable=KAO106 -- CLI UX\n'
+    assert _rules(_lint(sup)) == []
+    naked = 'def f(x):\n    print(x)  # kao: disable=KAO106\n'
+    rules = _rules(_lint(naked))
+    # a naked disable does not suppress AND is itself flagged
+    assert "KAO106" in rules and "KAO100" in rules
+
+
+def test_suppression_scope_is_one_line():
+    # a standalone comment covers the line BELOW it...
+    above = (
+        "def f(x):\n"
+        "    # kao: disable=KAO106 -- UX\n"
+        "    print(x)\n"
+    )
+    assert _rules(_lint(above)) == []
+    # ...but a trailing comment covers only its own line: a copy-pasted
+    # second violation underneath must still be reported
+    leak = (
+        "def f(x):\n"
+        "    print(x)  # kao: disable=KAO106 -- UX\n"
+        "    print(x)\n"
+    )
+    assert _rules(_lint(leak)) == ["KAO106"]
+
+
+# ----------------------------------------------------------- jaxpr layer
+
+def test_jaxpr_contracts_pass_on_real_solvers():
+    from kafka_assignment_optimizer_tpu.analysis.contracts import (
+        run_contracts,
+    )
+
+    rep = run_contracts()
+    assert rep.ok, [f.render() for f in rep.findings]
+    assert rep.checks_run >= 8
+
+
+def test_jaxpr_walker_detects_violations():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafka_assignment_optimizer_tpu.analysis.contracts import (
+        _check_jaxpr,
+    )
+
+    def f64(x):
+        return x + jnp.asarray(np.array([0.5, 1.5]))
+
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(f64)(jnp.zeros(2, jnp.float64))
+    found: list = []
+    _check_jaxpr(closed, "f64", found)
+    assert [f.rule for f in found] == ["KAO201"]
+
+    def cb(x):
+        jax.debug.callback(lambda v: None, x)
+        return x * 2
+
+    closed = jax.make_jaxpr(cb)(jnp.zeros(2, jnp.float32))
+    found = []
+    _check_jaxpr(closed, "cb", found)
+    assert [f.rule for f in found] == ["KAO201"]
+
+
+# ------------------------------------------------------------ self-check
+
+def test_kao_check_exits_zero_on_repo():
+    """The acceptance gate: the repo's own tree is clean under its own
+    analyzer. Lint-only here (cheap, no second jax startup inside the
+    gate); the jaxpr contract pass runs in-process in
+    ``test_jaxpr_contracts_pass_on_real_solvers`` and end-to-end in the
+    soak-tier full run below."""
+    r = subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu.analysis",
+         "--no-contracts"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stdout
+
+
+@pytest.mark.slow
+def test_kao_check_full_run_exits_zero_on_repo():
+    """The exact CI invocation — lint + jaxpr contracts in a fresh
+    interpreter. Marked slow: .github/workflows/kao-check.yml runs this
+    command on every push, so no pytest gate needs to pay the second
+    jax startup."""
+    r = subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu.analysis"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stdout
+
+
+def test_kao_check_exits_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    print(x)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu.analysis",
+         str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "KAO106" in r.stdout
+
+
+def test_lint_paths_api_clean_on_package():
+    assert lint_paths() == []
+
+
+# -------------------------------------------------------------- sanitizer
+
+@pytest.fixture
+def sanitizer():
+    from kafka_assignment_optimizer_tpu.analysis import sanitize
+
+    sanitize.reset()
+    sanitize.enable()
+    yield sanitize
+    sanitize.disable()
+    sanitize.reset()
+
+
+def test_sanitizer_recompile_budget(sanitizer):
+    key = ("solver", "sig")
+    for _ in range(sanitizer.compile_budget()):
+        sanitizer.note_compile(key)  # within budget: silent
+    with pytest.raises(sanitizer.RecompileBudgetError):
+        sanitizer.note_compile(key)
+    assert sanitizer.snapshot()["recompiles_total"] == 1
+
+
+def test_sanitizer_trip_resets_episode(sanitizer):
+    """A budget trip must not poison the key forever: the executable
+    was never cached, so the next request's cold rebuild restarts the
+    count instead of tripping on every later solve."""
+    key = ("solver", "sig")
+    for _ in range(sanitizer.compile_budget()):
+        sanitizer.note_compile(key)
+    with pytest.raises(sanitizer.RecompileBudgetError):
+        sanitizer.note_compile(key)
+    for _ in range(sanitizer.compile_budget()):
+        sanitizer.note_compile(key)  # fresh episode: full budget again
+    assert sanitizer.snapshot()["recompiles_total"] == 1
+
+
+def test_nan_abort_counted_once_per_exception(sanitizer):
+    e = FloatingPointError("nan")
+    sanitizer.note_nan_abort_once(e, "inner")
+    sanitizer.note_nan_abort_once(e, "outer")  # same exception object
+    assert sanitizer.snapshot()["nan_aborts_total"] == 1
+
+
+def test_kao_check_flag_guards(tmp_path):
+    for argv in (["--contracts-only", "--no-contracts"],
+                 ["--rule", "KAO999"]):
+        r = subprocess.run(
+            [sys.executable, "-m",
+             "kafka_assignment_optimizer_tpu.analysis", *argv],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 2, (argv, r.stdout, r.stderr)
+
+
+def test_sanitizer_forgets_evicted_keys(sanitizer):
+    """LRU eviction resets the recompile sentinel: a key's post-evict
+    rebuild is a legitimate cold compile, not thrash."""
+    key = ("solver", "sig")
+    for _ in range(sanitizer.compile_budget()):
+        sanitizer.note_compile(key)
+    sanitizer.forget_key(key)  # what mesh does on eviction
+    for _ in range(sanitizer.compile_budget()):
+        sanitizer.note_compile(key)  # full budget again, no trip
+    assert sanitizer.snapshot()["recompiles_total"] == 0
+
+
+def test_contracts_only_rejects_paths(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu.analysis",
+         "--contracts-only", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "does not take paths" in r.stderr
+
+
+def test_sanitizer_nan_and_donation_counters(sanitizer):
+    import numpy as np
+
+    with pytest.raises(sanitizer.SanitizerError):
+        sanitizer.check_host(np.array([1.0, np.nan], np.float32), "t")
+    with pytest.raises(sanitizer.DonationReuseError):
+        sanitizer.note_donation_reuse(("k",))
+    snap = sanitizer.snapshot()
+    assert snap["nan_aborts_total"] == 1
+    assert snap["donation_reuse_total"] == 1
+    assert snap["enabled"] == 1
+
+
+def test_sanitizer_disabled_is_inert():
+    from kafka_assignment_optimizer_tpu.analysis import sanitize
+
+    sanitize.reset()
+    assert not sanitize.enabled()
+    import numpy as np
+
+    sanitize.check_host(np.array([np.nan]), "t")  # no-op when off
+    sanitize.note_compile(("k",))  # never raises when off
+    assert sanitize.snapshot()["nan_aborts_total"] == 0
+
+
+def test_sanitized_solve_smoke(sanitizer, demo):
+    """KAO_SANITIZE acceptance: a small sweep solve under the armed
+    sanitizer completes with ZERO sentinel trips."""
+    from kafka_assignment_optimizer_tpu import optimize
+
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="tpu",
+                   engine="sweep", batch=4, sweeps=16)
+    assert res.report()["feasible"]
+    snap = sanitizer.snapshot()
+    assert snap["recompiles_total"] == 0
+    assert snap["nan_aborts_total"] == 0
+    assert snap["donation_reuse_total"] == 0
+
+
+def test_sanitizer_counters_on_metrics(sanitizer):
+    from kafka_assignment_optimizer_tpu.serve import render_metrics
+
+    text = render_metrics()
+    for fam in ("kao_sanitizer_recompiles_total",
+                "kao_sanitizer_nan_aborts_total"):
+        assert f"# HELP {fam} " in text
+        assert f"# TYPE {fam} counter" in text
+        assert any(
+            line.startswith(fam + " ")
+            for line in text.splitlines()
+        ), text
