@@ -32,6 +32,7 @@ RULES: dict[str, str] = {
     "KAO105": "Python if/while on a traced value inside a jit body",
     "KAO106": "bare print outside obs/log.py",
     "KAO107": "kao_* metric emitted without HELP/TYPE",
+    "KAO108": "chaos/resilience hook inside a traced (jit/solver-factory) body",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -26,6 +26,11 @@ caught only dynamically, alignment- or platform-dependently):
 - **KAO107** ``kao_*`` metric families emitted without ``# HELP`` +
   ``# TYPE`` in the same module (the Prometheus exposition contract
   tests/test_metrics_format.py pins).
+- **KAO108** chaos/resilience hooks inside traced bodies: a
+  ``resilience.chaos`` injection point (or a ladder ``note_rung``)
+  reached by jit/vmap/pallas tracing would bake the fault — or its
+  absence — into the compiled executable and desynchronize SPMD
+  workers; chaos is a HOST-SIDE-ONLY contract (docs/RESILIENCE.md).
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -132,6 +137,7 @@ def lint_source(
         out += _rule_broadcast_base(fn, path, parents.parent)
         out += _rule_key_reuse(fn, path)
     out += _rule_traced_branch(tree, path)
+    out += _rule_chaos_in_traced(tree, path)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -490,6 +496,47 @@ def _rule_traced_branch(tree, path) -> list[Finding]:
                     f"Python `{kind}` on a traced value inside a "
                     "jit/solver-factory body; use jnp.where / "
                     "lax.cond / lax.while_loop"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO108
+
+# the resilience surface that must stay host-side: the chaos harness's
+# firing/raising/sleeping entry points and the ladder's rung recorder
+# (it takes a lock and emits a log — both trace-hostile side effects)
+_CHAOS_HOOKS = {"fires", "raise_if", "sleep_if", "note_rung"}
+_CHAOS_MODULES = {"chaos", "ladder", "resilience"}
+
+
+def _rule_chaos_in_traced(tree, path) -> list[Finding]:
+    """Chaos hooks may never execute under jit/vmap/pallas tracing: a
+    traced hook bakes the fault (or its absence) into the compiled
+    executable — the chaos soak would then replay whatever the trace
+    captured instead of injecting live — and a raising hook inside an
+    SPMD body desynchronizes workers in front of collectives. Same
+    traced-body heuristic as KAO105 (jit-decorated functions plus
+    nested defs inside ``make_*`` solver factories)."""
+    out = []
+    seen: set[int] = set()
+    for fn in _traced_fns(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if (
+                len(chain) >= 2
+                and chain[-1] in _CHAOS_HOOKS
+                and chain[0].lstrip("_") in _CHAOS_MODULES
+                and node.lineno not in seen
+            ):
+                seen.add(node.lineno)
+                out.append(Finding(
+                    "KAO108", path, node.lineno,
+                    f"{'.'.join(chain)} inside a traced body: chaos "
+                    "hooks are host-side only (a traced hook bakes "
+                    "the fault into the executable and desyncs SPMD "
+                    "workers); inject at the dispatch call site "
+                    "instead (docs/RESILIENCE.md)"))
     return out
 
 

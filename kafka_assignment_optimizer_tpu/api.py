@@ -55,6 +55,12 @@ class OptimizeResult:
             "racks": self.instance.num_racks,
             **{f"solver_{k}": v for k, v in self.solve.stats.items()
                if isinstance(v, (int, float, str, bool))},
+            # degradation rungs taken during this solve
+            # (docs/RESILIENCE.md): the scalar fold above drops lists,
+            # but the ladder must be visible on the serving surface —
+            # a degraded plan that looks searched is an operator trap
+            **({"degradations": list(self.solve.stats["degradations"])}
+               if self.solve.stats.get("degradations") else {}),
         }
 
 

@@ -115,6 +115,15 @@ def build_parser() -> argparse.ArgumentParser:
         "trips fail the solve loudly instead of corrupting it quietly",
     )
     ap.add_argument(
+        "--chaos",
+        default=None,
+        metavar="SPEC",
+        help="arm the fault-injection harness for this solve (same as "
+        "KAO_CHAOS; docs/RESILIENCE.md), e.g. 'seed=7,pallas_fault' — "
+        "the solve must still return a valid certified-or-degraded "
+        "plan, with every degradation rung in the --report stats",
+    )
+    ap.add_argument(
         "--distributed",
         action="store_true",
         help="initialize jax's multi-host runtime before solving. Run "
@@ -186,6 +195,15 @@ def _run(args: argparse.Namespace) -> int:
         from .analysis import sanitize as _sanitize
 
         _sanitize.enable()
+    if args.chaos:
+        from .resilience import chaos as _chaos
+
+        try:
+            _chaos.arm(args.chaos)
+        except ValueError as e:
+            # kao: disable=KAO106 -- "error: ..." on stderr is the CLI's UX contract
+            print(f"error: bad --chaos spec: {e}", file=sys.stderr)
+            return 2
     if args.distributed:
         from .parallel.distributed import init_distributed
 

@@ -54,6 +54,10 @@ def request_schema() -> dict:
                     "options": "search knobs: seed, batch, rounds, sweeps, "
                                "steps_per_round, engine, time_limit_s, "
                                "t_hi, t_lo, n_devices",
+                    "deadline_s": "optional per-request end-to-end "
+                                  "deadline in seconds (queue wait + "
+                                  "solve; docs/RESILIENCE.md); expired "
+                                  "requests shed with 503 + Retry-After",
                 },
                 "response": {
                     "assignment": "the optimized reassignment JSON "

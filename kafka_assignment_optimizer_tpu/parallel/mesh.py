@@ -37,6 +37,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analysis import sanitize as _san
 from ..obs import trace as _otrace
+from ..resilience import budget as _rbudget
+from ..resilience import chaos as _chaos
+from ..resilience import ladder as _ladder
 from ..solvers.tpu.arrays import ModelArrays
 from ..solvers.tpu.bucket import STATS as _CACHE_STATS
 
@@ -151,6 +154,12 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
     dispatch if the AOT path fails (version quirks, sharding mismatch) —
     correctness never depends on the cache."""
     key = (solver_key, _arg_signature(args))
+    if _chaos.fires("exec_evict"):
+        # eviction-storm injection (docs/RESILIENCE.md): the warm
+        # executable vanishes under this dispatch, exactly as a stream
+        # of distinct bucket shapes would force; the path below must
+        # recompile-and-serve, never fail
+        clear_exec_cache()
     if _san.enabled() and not _args_alive(args):
         # sanitizer donation guard: refuse to dispatch a state that a
         # donating dispatch already consumed — a clear error here beats
@@ -181,6 +190,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                     # failing — the jit retry cannot run on dead args
                     raise
                 _CACHE_STATS.record_exec(False, fallback=True)
+                _ladder.note_rung("aot_to_jit", cause="exec_failed")
                 with _otrace.span("dispatch", cache="fallback"):
                     return fn(*args)
         if inflight is None:
@@ -191,12 +201,17 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
         # which serializes on jax's own compile cache anyway)
         if not inflight.wait(timeout=600.0):
             _CACHE_STATS.record_exec(False, fallback=True)
+            _ladder.note_rung("aot_to_jit", cause="compile_wedged")
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
     t0 = time.perf_counter()
     try:
         try:
+            # compile-failure injection point: raised HERE (host side,
+            # before lowering) so the fault takes the same route a real
+            # AOT lower/compile error takes — the jit fallback below
             with _otrace.span("compile"):
+                _chaos.raise_if("compile_fail")
                 ex = _lower_and_compile(fn, args)
             # recompile sentinel (analysis.sanitize): a key compiling
             # past its budget means executable thrash — fail the solve
@@ -210,6 +225,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             if not _args_alive(args):
                 raise
             _CACHE_STATS.record_exec(False, fallback=True)
+            _ladder.note_rung("aot_to_jit", cause="compile_failed")
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
         _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
@@ -627,20 +643,56 @@ def solve_on_mesh(
     return _dispatch(fn, solver_key, (m, a_seed, keys, temps))
 
 
+def _fetch_once(x):
+    if jax.process_count() == 1:
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+
+    return jax.device_get(
+        multihost_utils.process_allgather(x, tiled=True)
+    )
+
+
+def _transfer_retryable(e: BaseException) -> bool:
+    """Only genuinely transient transfer faults earn the one retry:
+    the injected chaos fault and runtime-transport errors (a tunneled
+    TPU dropping a DMA). Anything else — dead buffers, sharding bugs —
+    must surface with its real traceback."""
+    if _chaos.is_fault(e):
+        return True
+    msg = f"{type(e).__name__}: {e}"
+    return any(s in msg for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
+
+
 def fetch_global(x):
     """``device_get`` that also works under multi-controller SPMD: a
     global array sharded over a multi-process mesh spans devices this
     process cannot address, so it must be allgathered to every host
     first (a few hundred KB of per-shard winners, outside the hot
-    loop). Single-process — the common case — stays a plain transfer."""
-    with _otrace.span("device_transfer"):
-        if jax.process_count() == 1:
-            return jax.device_get(x)
-        from jax.experimental import multihost_utils
+    loop). Single-process — the common case — stays a plain transfer.
 
-        return jax.device_get(
-            multihost_utils.process_allgather(x, tiled=True)
-        )
+    One transient-fault retry (jittered backoff): a dropped transfer on
+    a tunneled device is recoverable and must not abandon a multi-chunk
+    anneal; the ``transfer_retry`` ladder rung records it."""
+    with _otrace.span("device_transfer"):
+        try:
+            _chaos.raise_if("device_transfer")
+            return _fetch_once(x)
+        except Exception as e:
+            if not _transfer_retryable(e):
+                raise
+            if jax.process_count() != 1:
+                # multi-controller: the fault was observed by THIS
+                # process only — peers may have completed their
+                # allgather, and a second collective issued from one
+                # process desynchronizes the SPMD program order (the
+                # engine holds the same workers-must-agree line for
+                # its fallbacks), so the fault surfaces instead of
+                # earning a local retry
+                raise
+            _ladder.note_rung("transfer_retry", error=repr(e)[:200])
+            time.sleep(_rbudget.backoff_s(0, base_s=0.05, cap_s=0.5))
+            return _fetch_once(x)
 
 
 class _AsyncFetch:

@@ -1,0 +1,22 @@
+"""Resilience subsystem: failure as a first-class, testable input.
+
+Four small, dependency-light modules (stdlib + obs only — importable
+from the lowest layers without cycles):
+
+- :mod:`.chaos` — the ``KAO_CHAOS`` / ``--chaos`` fault-injection
+  harness: named, host-side-only injection points threaded through
+  ``parallel.mesh``, ``solvers.tpu.engine`` and ``serve`` (kao-check
+  rule KAO108 keeps chaos hooks out of traced bodies).
+- :mod:`.budget` — the per-solve/request deadline-and-retry budget
+  (remaining-time threading + the shared jittered exponential backoff).
+- :mod:`.ladder` — the graceful-degradation ladder: named rungs,
+  recorded simultaneously in solve stats, trace spans and the
+  ``kao_degradations_total{rung=}`` metric.
+- :mod:`.breaker` — the serving path's per-bucket circuit breaker.
+
+Catalog, rung semantics and the budget contract: docs/RESILIENCE.md.
+"""
+
+from . import breaker, budget, chaos, ladder  # noqa: F401
+
+__all__ = ["breaker", "budget", "chaos", "ladder"]

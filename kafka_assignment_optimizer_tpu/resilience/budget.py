@@ -1,0 +1,103 @@
+"""Per-solve deadline/retry budget — the ONE remaining-time object.
+
+Before this module, deadline handling was ad-hoc ``t0 + time_limit_s``
+arithmetic repeated at every join/retry site in the engine and the
+serving path, and the satellite bug class it bred was real: a fallback
+retry granted the FULL original budget after the first attempt had
+already spent it. :class:`Budget` fixes the shape of the problem — the
+budget is created once per request/solve, every wait and retry asks it
+for ``remaining()``, and composition (a server-side request deadline
+capping a client time limit) is ``min`` over remainings.
+
+Retries across the ladder (worker respawn, transfer retry, circuit
+probation) share one jittered exponential backoff, :func:`backoff_s` —
+jitter decorrelates retry storms, the cap keeps a retry from eating the
+budget, and a Budget-bound sleep never overshoots the deadline.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["Budget", "backoff_s", "jitter_factor"]
+
+_RNG = random.Random()
+
+
+def jitter_factor(jitter: float) -> float:
+    """Uniform scale factor in ``[1-jitter, 1+jitter]`` (floored at
+    0) — the one jitter shape every retry/cooldown in the ladder
+    shares, so storms decorrelate the same way everywhere."""
+    lo = max(0.0, 1.0 - jitter)
+    return lo + (1.0 + jitter - lo) * _RNG.random()
+
+
+def backoff_s(attempt: int, base_s: float = 0.05, cap_s: float = 2.0,
+              jitter: float = 0.5) -> float:
+    """Jittered exponential backoff: ``base * 2**attempt`` capped at
+    ``cap_s``, scaled by :func:`jitter_factor`. ``attempt`` counts
+    from 0 (the first retry)."""
+    raw = min(float(base_s) * (2.0 ** max(int(attempt), 0)), float(cap_s))
+    return raw * jitter_factor(jitter)
+
+
+class Budget:
+    """Remaining-time accounting for one solve/request.
+
+    ``Budget(None)`` is the unlimited budget: ``remaining()`` is None,
+    ``expired()`` is False, ``cap()`` passes timeouts through — so call
+    sites need no ``if time_limit_s is None`` forests."""
+
+    __slots__ = ("t0", "limit_s")
+
+    def __init__(self, limit_s: float | None, t0: float | None = None):
+        self.limit_s = None if limit_s is None else float(limit_s)
+        self.t0 = time.perf_counter() if t0 is None else float(t0)
+
+    @property
+    def deadline(self) -> float | None:
+        """Absolute ``time.perf_counter()`` deadline (None = none)."""
+        if self.limit_s is None:
+            return None
+        return self.t0 + self.limit_s
+
+    def remaining(self) -> float | None:
+        """Seconds left (clamped at 0.0); None = unlimited."""
+        if self.limit_s is None:
+            return None
+        return max(0.0, self.t0 + self.limit_s - time.perf_counter())
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0.0
+
+    def cap(self, timeout_s: float | None) -> float | None:
+        """``timeout_s`` bounded by the remaining budget — the join/wait
+        timeout helper (None in, remaining out; unlimited budget passes
+        ``timeout_s`` through unchanged)."""
+        r = self.remaining()
+        if r is None:
+            return timeout_s
+        if timeout_s is None:
+            return r
+        return min(float(timeout_s), r)
+
+    def sleep_backoff(self, attempt: int, base_s: float = 0.05,
+                      cap_s: float = 2.0) -> float:
+        """Sleep one jittered-backoff step, never past the deadline;
+        returns the seconds actually slept."""
+        s = backoff_s(attempt, base_s, cap_s)
+        r = self.remaining()
+        if r is not None:
+            s = min(s, r)
+        if s > 0:
+            time.sleep(s)
+        return s
+
+    def __repr__(self) -> str:
+        r = self.remaining()
+        return (
+            f"Budget(unlimited)" if r is None
+            else f"Budget(limit={self.limit_s:.3f}s, left={r:.3f}s)"
+        )

@@ -1,0 +1,120 @@
+"""The graceful-degradation ladder — one ordered, observable policy.
+
+The repo grew its fallbacks one incident at a time (Pallas→XLA
+drain-and-retry, sweep→chain engine retry, pipeline drain, deadline
+truncation, checkpoint-skip, serve worker respawn); each worked but
+none were legible as a SYSTEM. This module names the rungs, orders
+them from cheapest to most drastic, and makes every step down
+observable in all three places at once:
+
+- the solve's ``stats["degradations"]`` list (ambient collector,
+  activated by the engine entry points);
+- a zero-duration ``degrade`` span mark on the active solve trace
+  (``/debug/solves/<id>``);
+- the ``kao_degradations_total{rung=...}`` counter on ``/metrics``.
+
+The acceptance contract (tests/test_resilience.py) is that for every
+injected fault the three views agree. Rung semantics and the full
+policy table live in docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+
+from ..obs import trace as _otrace
+
+__all__ = [
+    "RUNGS", "note_rung", "collect", "collect_lane", "snapshot", "reset",
+]
+
+# the ladder, cheapest rung first. Results stay bit-identical through
+# "pipelined_to_sync"; from "pallas_to_xla" down the executable changes
+# but the trajectory contract holds (scorer parity); "sweep_to_chain"
+# changes the search; "anneal_to_construct" abandons the device search
+# for the host constructor/greedy path (flagged degraded unless it
+# certifies); the rest are serving/persistence containment steps.
+RUNGS: tuple[str, ...] = (
+    "pipelined_to_sync",    # drain speculation, retry chunk synchronously
+    "aot_to_jit",           # AOT executable path failed; plain jit dispatch
+    "transfer_retry",       # device->host transfer retried after a fault
+    "pallas_to_xla",        # Mosaic scorer fault; chunk re-run on XLA
+    "deadline_truncated",   # budget bit: ladder stopped early, best-so-far
+    "checkpoint_skipped",   # checkpoint write failed; solve continued
+    "sweep_to_chain",       # defaulted sweep infeasible; chain engine retry
+    "anneal_to_construct",  # device path unusable; host greedy/constructor
+    "worker_restart",       # serve worker crashed; respawned (+1 retry)
+)
+
+_LOCK = threading.Lock()
+_COUNTS: dict[str, int] = {r: 0 for r in RUNGS}
+
+# ambient per-solve rung collector: the OUTERMOST engine entry point
+# owns the list (nested solves — the chain retry, per-lane fallbacks —
+# feed the same one), and copies it into stats["degradations"].
+_ACTIVE: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "kao_degradation_rungs", default=None
+)
+
+
+def note_rung(rung: str, **attrs) -> None:
+    """Record one step down the ladder: counter + trace mark +
+    structured log + the ambient per-solve collector."""
+    with _LOCK:
+        _COUNTS[rung] = _COUNTS.get(rung, 0) + 1
+    lst = _ACTIVE.get()
+    if lst is not None:
+        lst.append(rung)
+    _otrace.mark("degrade", rung=rung, **attrs)
+    from ..obs import log as _olog
+
+    _olog.warn("degradation", rung=rung, **attrs)
+
+
+@contextlib.contextmanager
+def collect():
+    """Activate the per-solve rung collector on this context; yields
+    the list, or None when an OUTER collector is already active (nested
+    solves append to the outermost one, so a retry's rungs land on the
+    request-level stats exactly once)."""
+    if _ACTIVE.get() is not None:
+        yield None
+        return
+    lst: list = []
+    token = _ACTIVE.set(lst)
+    try:
+        yield lst
+    finally:
+        _ACTIVE.reset(token)
+
+
+@contextlib.contextmanager
+def collect_lane():
+    """Per-lane scope inside a batch solve: rungs taken here land on
+    the yielded list ONLY, shadowing the batch-level collector — a
+    single lane's sequential fallback must not flag the other lanes'
+    stats as degraded (counter and trace marks still fire globally)."""
+    lst: list = []
+    token = _ACTIVE.set(lst)
+    try:
+        yield lst
+    finally:
+        _ACTIVE.reset(token)
+
+
+def snapshot() -> dict[str, int]:
+    """rung -> times taken, every cataloged rung present (zeros
+    included, so /metrics pre-declares the full family)."""
+    with _LOCK:
+        out = {r: 0 for r in RUNGS}
+        out.update(_COUNTS)
+        return out
+
+
+def reset() -> None:
+    """Zero the counters (tests)."""
+    with _LOCK:
+        for k in list(_COUNTS):
+            _COUNTS[k] = 0

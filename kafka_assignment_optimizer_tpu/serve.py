@@ -30,7 +30,10 @@ Endpoints:
 
     Errors: 400 malformed JSON/schema or disallowed option (body
     ``{"error": ...}``), 422 model rejected the inputs, 500 solver
-    failure, 503 solver saturated past ``--lock-wait-s``.
+    failure, 503 load shed — queue full past ``--lock-wait-s``, open
+    circuit, or an exhausted per-request ``deadline_s`` — always with a
+    ``Retry-After`` header and ``reason``/``retry_after_s`` in the body
+    (docs/RESILIENCE.md).
 
 ``POST /evaluate``
     Audit an EXISTING plan (same fields as ``/submit`` minus
@@ -111,6 +114,10 @@ from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
 from .obs import log as _olog
 from .obs import trace as _otrace
+from .resilience import breaker as _breaker
+from .resilience import budget as _rbudget
+from .resilience import chaos as _chaos
+from .resilience import ladder as _ladder
 
 # audits (/evaluate) hold their OWN lock (VERDICT r4 item 8): they are
 # pure host-side work (numpy + bound LPs + the native flow kernel — no
@@ -140,6 +147,30 @@ DEFAULT_LOCK_WAIT_S = 30.0
 DEFAULT_MAX_SOLVE_S = 300.0
 DEFAULT_WORKERS = 2
 DEFAULT_QUEUE_DEPTH = 4
+# maintenance drain window (--queue-wait-s): how long the periodic
+# cache-clear waits for in-flight solves before skipping the clear
+# (satellite fix, ISSUE 6: this was a hard-coded 15.0)
+DEFAULT_QUEUE_WAIT_S = 15.0
+
+# serve-side resilience knobs (docs/RESILIENCE.md), set by main():
+# - default_deadline_s: per-request end-to-end deadline applied when
+#   the request carries no "deadline_s" field (None = no deadline
+#   beyond --max-solve-s);
+# - checkpoint_dir: operator-chosen directory for per-cluster solve
+#   checkpoints, keyed by instance FINGERPRINT (never a client path —
+#   the path-valued-option rejection above still stands). Enables
+#   crash-safe auto-resume: a retried or repeated solve of the same
+#   cluster warm-starts from the last completed plan.
+RESILIENCE = {
+    "default_deadline_s": None,
+    "checkpoint_dir": None,
+}
+
+# circuit breaker on repeated solver failures per bucket key
+# (resilience.breaker): a bucket that keeps failing compile/dispatch
+# sheds instantly with Retry-After instead of burning a full
+# compile-and-crash cycle per request
+_BREAKER = _breaker.CircuitBreaker()
 # request coalescing (--batch-window-ms / --max-batch): same-bucket TPU
 # solves that arrive while the pool is busy are grouped for up to the
 # window, then submitted as ONE batched lane solve (engine.solve_tpu_batch)
@@ -219,6 +250,7 @@ class _SolveQueue:
     def __init__(self, workers: int = DEFAULT_WORKERS,
                  depth: int = DEFAULT_QUEUE_DEPTH):
         self.workers = max(1, int(workers))
+        self.queue_wait_s = DEFAULT_QUEUE_WAIT_S
         self._q: _queue.Queue = _queue.Queue(maxsize=max(1, int(depth)))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -228,10 +260,14 @@ class _SolveQueue:
         self._draining = False  # maintenance holds new solves at the gate
 
     def configure(self, workers: int | None = None,
-                  depth: int | None = None) -> None:
+                  depth: int | None = None,
+                  queue_wait_s: float | None = None) -> None:
         """Resize before the workers start (server startup); a no-op
-        once traffic has begun."""
+        once traffic has begun (``queue_wait_s`` may change anytime —
+        it only gates the next maintenance drain)."""
         with self._lock:
+            if queue_wait_s is not None:
+                self.queue_wait_s = max(float(queue_wait_s), 0.0)
             if self._started:
                 return
             if workers is not None:
@@ -254,26 +290,56 @@ class _SolveQueue:
             item = self._q.get()
             if item.abandoned:  # waiter gave up while queued
                 continue
-            with self._cv:
-                # maintenance in progress: no new trace/compile may
-                # start until the cache clear has landed
-                while self._draining:
-                    self._cv.wait()
-                self._active += 1
+            if _chaos.fires("worker_crash"):
+                # chaos (docs/RESILIENCE.md): this worker dies holding
+                # a request. The containment path is _respawn — a
+                # replacement worker starts and the crashed request
+                # gets its one retry there, so pool capacity is never
+                # silently lost and the waiter never hangs.
+                self._respawn(item)
+                return  # the crash: this worker thread exits
+            self._execute(item)
+
+    def _execute(self, item: _QueueItem) -> None:
+        with self._cv:
+            # maintenance in progress: no new trace/compile may
+            # start until the cache clear has landed
+            while self._draining:
+                self._cv.wait()
+            self._active += 1
+        try:
             try:
-                try:
-                    item.result = item.fn()
-                except BaseException as e:  # delivered to the waiter
-                    item.exc = e
-                item.done.set()
-            finally:
-                with self._cv:
-                    self._active -= 1
-                    self._done_count += 1
-                    n = self._done_count
-                    self._cv.notify_all()
-            if n % _CLEAR_CACHES_EVERY == 0:
-                self._maintenance()
+                item.result = item.fn()
+            except BaseException as e:  # delivered to the waiter
+                item.exc = e
+            item.done.set()
+        finally:
+            with self._cv:
+                self._active -= 1
+                self._done_count += 1
+                n = self._done_count
+                self._cv.notify_all()
+        if n % _CLEAR_CACHES_EVERY == 0:
+            self._maintenance()
+
+    def _respawn(self, item: _QueueItem) -> None:
+        """A worker crashed mid-request (today only the ``worker_crash``
+        chaos point can get here — ``_execute`` contains genuine solve
+        exceptions and delivers them to the waiter). Start a replacement
+        worker and give the in-flight request its ONE retry on it; with
+        ``--checkpoint-dir`` the retried solve auto-resumes from the
+        last completed checkpoint of the same cluster."""
+        _ladder.note_rung("worker_restart")
+        _olog.error("worker_crashed", respawned=True,
+                    retrying=not item.abandoned)
+
+        def run():
+            if not item.abandoned:
+                self._execute(item)
+            self._run()
+
+        threading.Thread(target=run, daemon=True,
+                         name="kao-solve-respawn").start()
 
     def _maintenance(self) -> None:
         """Long-lived-process executable bound: a stream of distinct
@@ -296,7 +362,10 @@ class _SolveQueue:
             if self._draining:
                 return
             self._draining = True
-            deadline = time.monotonic() + 15.0
+            # drain window (--queue-wait-s; was a hard-coded 15.0 —
+            # satellite fix, ISSUE 6): a busy pool bounds how long the
+            # clear may hold the gate before skipping
+            deadline = time.monotonic() + self.queue_wait_s
             while self._active > 0:
                 left = deadline - time.monotonic()
                 if left <= 0 or not self._cv.wait(timeout=left):
@@ -335,13 +404,18 @@ class _SolveQueue:
         self._ensure_started()
         item = _QueueItem(fn)
         try:
+            if _chaos.fires("queue_overload"):
+                # chaos: the queue reports no capacity — the request
+                # must take the exact shed path a saturated pool takes
+                raise _queue.Full
             self._q.put(item, timeout=max(float(wait_s), 0.0))
         except _queue.Full:
-            _count(shed_total=1)
-            raise ApiError(
-                503,
+            raise _shed(
+                "queue_full",
                 f"solver busy (no capacity within {wait_s:.0f}s); "
                 "retry later",
+                retry_after_s=self._retry_after_hint(),
+                queue_wait_s=self.queue_wait_s,
             ) from None
         # budget_s None means the operator runs uncapped solves
         # (--max-solve-s 0 with no client limit): wait to completion,
@@ -352,15 +426,25 @@ class _SolveQueue:
         )
         if not item.done.wait(window):
             item.abandoned = True  # dropped if still queued; best effort
-            _count(shed_total=1)
-            raise ApiError(
-                503,
+            raise _shed(
+                "service_window",
                 f"solve did not finish within the {window:.0f}s service "
                 "window; retry later",
+                retry_after_s=self._retry_after_hint(),
             )
         if item.exc is not None:
             raise item.exc
         return item.result
+
+    def _retry_after_hint(self) -> float:
+        """Retry-After for queue sheds: roughly one queue's worth of
+        the last observed solve time, clamped to [1, 60] s — an honest
+        hint beats a constant, and the clamp keeps a pathological
+        sample from telling clients to go away for an hour."""
+        with _METRICS_LOCK:
+            last = _METRICS["last_solve_seconds"]
+        backlog = max(self._q.qsize(), 1)
+        return min(max(last * backlog, 1.0), 60.0)
 
 
 _SOLVES = _SolveQueue()
@@ -373,7 +457,6 @@ _METRICS = {
     "solves_total": 0,        # solves completed successfully
     "evaluates_total": 0,     # plan audits completed successfully
     "errors_total": 0,        # 4xx/5xx responses (excl. 503 sheds)
-    "shed_total": 0,          # 503 saturation sheds
     "solve_seconds_total": 0.0,
     "last_solve_seconds": 0.0,
     # request coalescing (the batched lane path)
@@ -388,12 +471,64 @@ _METRICS = {
 # batch-size histogram: coalesced dispatch size -> count (rendered as
 # the labeled counter family kao_batch_size_total{size="N"})
 _BATCH_SIZES: dict[int, int] = {}
+# 503 sheds by reason (rendered as kao_shed_total{reason="..."}):
+# every shed path names why it shed, and the full reason set is
+# pre-declared so /metrics always exposes the family at zero
+_SHED_REASON_NAMES = (
+    "queue_full", "service_window", "coalesce_window", "audit_busy",
+    "circuit_open", "deadline",
+)
+_SHED_REASONS: dict[str, int] = {}
 
 
 def _count(**updates) -> None:
     with _METRICS_LOCK:
         for k, v in updates.items():
             _METRICS[k] += v
+
+
+def _shed(reason: str, message: str, retry_after_s: float,
+          **body_extra) -> "ApiError":
+    """Count one load shed and build its 503: the response carries a
+    ``Retry-After`` header (and ``retry_after_s``/``reason`` in the
+    body) so well-behaved clients back off instead of hammering a
+    saturated service. Callers ``raise _shed(...)``."""
+    with _METRICS_LOCK:
+        _SHED_REASONS[reason] = _SHED_REASONS.get(reason, 0) + 1
+    return ApiError(
+        503, message, retry_after_s=retry_after_s,
+        body={"reason": reason, "retry_after_s": round(retry_after_s, 3),
+              **body_extra},
+    )
+
+
+def _breaker_guarded(key: tuple, call):
+    """Run one dispatch under the per-bucket circuit breaker: an OPEN
+    circuit sheds instantly with 503 + Retry-After (no compile-and-
+    crash cycle); solver-side failures trip it, client-side errors
+    (ApiError sheds/validation, model rejections) never do."""
+    admitted, retry_after = _BREAKER.allow(key)
+    if not admitted:
+        raise _shed(
+            "circuit_open",
+            "circuit open for this cluster bucket after repeated "
+            "solver failures; retry later",
+            retry_after_s=retry_after,
+        )
+    try:
+        out = call()
+    except (ApiError, ValueError, KeyError, TypeError):
+        # saturation sheds / model rejections — no solver verdict, not
+        # this bucket's fault. If this caller held the half-open probe,
+        # release it so a later request can probe again (a shed probe
+        # must not wedge the circuit open forever).
+        _BREAKER.release_probe(key)
+        raise
+    except BaseException:
+        _BREAKER.record_failure(key)
+        raise
+    _BREAKER.record_success(key)
+    return out
 
 
 def _record_batch(size: int, waited_s: float, reports: list[dict]) -> None:
@@ -425,6 +560,8 @@ def render_metrics() -> str:
     with _METRICS_LOCK:
         snap = dict(_METRICS)
         sizes = dict(_BATCH_SIZES)
+        sheds = {r: 0 for r in _SHED_REASON_NAMES}
+        sheds.update(_SHED_REASONS)
     # executable/bucket cache counters (solvers.tpu.bucket.STATS): the
     # operational evidence that shape bucketing is absorbing compiles —
     # kao_cache_exec_hits climbing while kao_cache_compiles_total stays
@@ -445,6 +582,14 @@ def render_metrics() -> str:
     # unless KAO_SANITIZE / --sanitize armed the guards
     for k, v in _sanitize_mod.snapshot().items():
         snap[f"sanitizer_{k}"] = v
+    # resilience gauges (docs/RESILIENCE.md): circuit-breaker state and
+    # whether a chaos spec is armed (a production scrape showing
+    # kao_chaos_armed 1 is itself an alert)
+    brk = _BREAKER.snapshot()
+    snap["breaker_open_keys"] = brk["open"]
+    snap["breaker_tracked_keys"] = brk["tracked"]
+    snap["breaker_trips_total"] = brk["trips_total"]
+    snap["chaos_armed"] = _chaos.snapshot()["armed"]
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
@@ -460,6 +605,25 @@ def render_metrics() -> str:
         lines.append(
             f'kao_batch_size_total{{size="{size}"}} {sizes[size]}'
         )
+    # load sheds by reason: every 503 names why it shed, and the full
+    # reason set is pre-declared at zero so dashboards can alert on
+    # rate() without waiting for the first shed
+    lines.append("# HELP kao_shed_total load sheds (503) by reason")
+    lines.append("# TYPE kao_shed_total counter")
+    for reason in sorted(sheds):
+        lines.append(
+            f'kao_shed_total{{reason="{reason}"}} {sheds[reason]}'
+        )
+    # graceful-degradation ladder rungs (resilience.ladder): the full
+    # rung catalog is pre-declared at zero; any nonzero rate here means
+    # the service is trading quality/latency for availability
+    lines.append(
+        "# HELP kao_degradations_total graceful-degradation ladder "
+        "rungs taken (docs/RESILIENCE.md)"
+    )
+    lines.append("# TYPE kao_degradations_total counter")
+    for rung, n in _ladder.snapshot().items():
+        lines.append(f'kao_degradations_total{{rung="{rung}"}} {n}')
     # per-phase solve latency histograms, aggregated from solve traces
     # (obs.trace): which pipeline phase the wall-clock goes to, across
     # every traced solve this process has served
@@ -492,9 +656,17 @@ def render_metrics() -> str:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    """HTTP-status-carrying error. ``retry_after_s`` becomes the
+    response's ``Retry-After`` header (503 sheds); ``body`` merges
+    extra structured fields into the JSON error body."""
+
+    def __init__(self, status: int, message: str, *,
+                 retry_after_s: float | None = None,
+                 body: dict | None = None):
         super().__init__(message)
         self.status = status
+        self.retry_after_s = retry_after_s
+        self.body_extra = body or {}
 
 
 class _BatchGroup:
@@ -600,11 +772,11 @@ class _Coalescer:
         )
         if not waiter.done.wait(window):
             waiter.abandoned = True
-            _count(shed_total=1)
-            raise ApiError(
-                503,
+            raise _shed(
+                "coalesce_window",
                 f"batched solve did not finish within the {window:.0f}s "
                 "service window; retry later",
+                retry_after_s=_SOLVES._retry_after_hint(),
             )
         if waiter.exc is not None:
             raise waiter.exc
@@ -629,36 +801,87 @@ class _Coalescer:
         def job():
             return _run_batch_job(entries)
 
+        # the group key is (*bucket_key, non_seed_options): the breaker
+        # verdict for this dispatch lands on the bucket identity, ONCE
+        # — handle_submit already did the admission check per request
+        bucket_key = grp.key[:-1]
         try:
             outs = _SOLVES.submit(job, wait_s=grp.wait_s,
                                   budget_s=grp.budget_s)
         except BaseException as e:
+            if isinstance(e, (ApiError, ValueError, KeyError,
+                              TypeError)):
+                _BREAKER.release_probe(bucket_key)  # shed: no verdict
+            else:
+                _BREAKER.record_failure(bucket_key)
             for w in waiters:
                 w.exc = e
                 w.done.set()
             return
-        _record_batch(len(outs), waited,
-                      [o["report"] for o in outs])
+        # per-entry results: a dict to deliver, or the ApiError shed
+        # for a member whose deadline expired while the batch queued.
+        # The breaker verdict needs a solve to have RUN: if every
+        # member was shed pre-solve there is no evidence either way,
+        # so a pending half-open probe is released, not judged
+        solved = [o for o in outs if not isinstance(o, BaseException)]
+        if solved:
+            _BREAKER.record_success(bucket_key)
+            _record_batch(len(solved), waited,
+                          [o["report"] for o in solved])
+        else:
+            _BREAKER.release_probe(bucket_key)
         for w, out in zip(waiters, outs):
-            w.result = out
+            if isinstance(out, BaseException):
+                w.exc = out
+            else:
+                w.result = out
             w.done.set()
 
 
-def _run_batch_job(entries: list[dict]) -> list[dict]:
+def _run_batch_job(entries: list[dict]) -> list:
     """Worker-pool body of one coalesced dispatch: one batched lane
     solve, per-request response dicts out (same shape as /submit's
-    single-solve response). The batch runs under ONE trace — the first
-    member's trace ID — and every member's response echoes that shared
-    ID, so any of them retrieves the batch's solve report."""
+    single-solve response) — or, per entry, the ApiError to deliver
+    instead. The batch runs under ONE trace — the first member's trace
+    ID — and every member's response echoes that shared ID, so any of
+    them retrieves the batch's solve report.
+
+    Deadline contract (docs/RESILIENCE.md): each entry carries its
+    request Budget. The queue wait between _flush and here is bounded
+    by the worker pool, not by any member's deadline — so members
+    whose deadline expired while the batch was queued are shed NOW
+    with the same 503 "deadline" the single-solve path returns, and
+    the solve runs on the TIGHTEST remaining member window instead of
+    the full one."""
     from .api import optimize_batch
 
     t0 = time.perf_counter()
+    results: list = [None] * len(entries)
+    live: list[int] = []
+    for i, e in enumerate(entries):
+        rem = e["budget"].remaining() if e.get("budget") else None
+        if rem is not None and rem <= 0.0:
+            results[i] = _shed(
+                "deadline",
+                "request deadline exhausted while the batched solve "
+                "was queued; retry with a larger deadline_s",
+                retry_after_s=1.0,
+            )
+        else:
+            live.append(i)
+    if not live:
+        return results
+    entries = [entries[i] for i in live]
     trace_id = next(
         (e.get("trace_id") for e in entries if e.get("trace_id")), None
     )
     opts = dict(entries[0]["options"])
     budgets = [e["options"].get("time_limit_s") for e in entries
                if e["options"].get("time_limit_s") is not None]
+    budgets += [
+        e["budget"].remaining() for e in entries
+        if e.get("budget") and e["budget"].remaining() is not None
+    ]
     if budgets:
         opts["time_limit_s"] = min(budgets)
     tr = _otrace.begin(trace_id, name="request_batch",
@@ -690,14 +913,13 @@ def _run_batch_job(entries: list[dict]) -> list[dict]:
         _otrace.finish(tr)
     _olog.log("solve_batch", trace_id=trace_id, lanes=len(outs),
               wall_s=round(dt, 4))
-    return [
-        {
+    for j, (o, rep) in enumerate(zip(outs, reps)):
+        results[live[j]] = {
             "assignment": o.assignment.to_dict(),
             "report": rep,
             **({"trace_id": trace_id} if trace_id else {}),
         }
-        for o, rep in zip(outs, reps)
-    ]
+    return results
 
 
 _COALESCER = _Coalescer()
@@ -808,6 +1030,25 @@ def handle_submit(
         options["time_limit_s"] = (
             max_solve_s if limit is None else min(float(limit), max_solve_s)
         )
+    # per-request end-to-end deadline (docs/RESILIENCE.md): the request
+    # field wins, --default-deadline-s covers requests that carry none.
+    # One Budget object threads the REMAINING time through queue wait
+    # and solve — the solve gets what is left after validation and
+    # queueing, never the full window again.
+    deadline_s = payload.get("deadline_s", RESILIENCE["default_deadline_s"])
+    if deadline_s is not None and (
+        isinstance(deadline_s, bool)
+        or not isinstance(deadline_s, (int, float)) or not deadline_s > 0
+    ):
+        raise ApiError(400, "'deadline_s' must be a positive number")
+    budget = _rbudget.Budget(deadline_s)
+    if deadline_s is not None:
+        lim = options.get("time_limit_s")
+        options["time_limit_s"] = (
+            float(deadline_s) if lim is None
+            else min(float(lim), float(deadline_s))
+        )
+    lock_wait_s = budget.cap(lock_wait_s)
 
     # request-scoped trace ID: generated here, propagated into the
     # solve (ambient obs.trace), echoed in the response envelope, and
@@ -821,15 +1062,42 @@ def handle_submit(
         # path below reuses it either way.
         inst = None
         bucket_key = None
+        # every per-bucket gate below (coalescing eligibility, circuit
+        # breaker, checkpoint auto-resume, profiling budget) keys on
+        # the solver that will ACTUALLY run: "auto" resolves
+        # deterministically from the instance size, and at production
+        # scale that is the TPU engine — a defaulted request must get
+        # the same per-cluster isolation and resume behavior as an
+        # explicit "solver": "tpu", not one shared ("solver", "auto")
+        # circuit that a single pathological cluster could open for
+        # the whole fleet
+        solver_eff = solver
+        if solver == "auto":
+            from .models.instance import build_instance
+            from .solvers.base import resolve_solver
+
+            inst = build_instance(current, brokers, topology, rf)
+            solver_eff = resolve_solver("auto", inst)
         if (
-            solver == "tpu"
+            solver_eff == "tpu"
             and _COALESCER.enabled()
+            # a request carrying an EXPLICIT deadline takes the
+            # single-solve path (its owner asked for precise deadline
+            # semantics; _solve_job threads the remaining budget and
+            # sheds pre-dispatch). Defaulted requests ride the lane —
+            # the operator's --default-deadline-s must NOT disable
+            # coalescing fleet-wide — and carry their Budget into the
+            # batch: _run_batch_job sheds members whose deadline
+            # expired while the batch was queued and runs the solve on
+            # the TIGHTEST remaining member window
+            and payload.get("deadline_s") is None
             and set(options) <= _BATCHABLE_OPTIONS
         ):
             from .models.instance import build_instance
             from .solvers.tpu import bucket
 
-            inst = build_instance(current, brokers, topology, rf)
+            if inst is None:
+                inst = build_instance(current, brokers, topology, rf)
             non_seed = tuple(sorted(
                 (k, v) for k, v in options.items() if k != "seed"
             ))
@@ -837,36 +1105,87 @@ def handle_submit(
                           *bucket.bucket_shape(inst))
             key = (*bucket_key, non_seed)
             if not _COALESCER.should_bypass(key):
+                # breaker admission only: the failure/success verdict
+                # is recorded ONCE per batched dispatch in
+                # _Coalescer._flush — per-waiter recording would turn
+                # one failed batch into >= threshold trips
+                admitted, retry_after = _BREAKER.allow(bucket_key)
+                if not admitted:
+                    raise _shed(
+                        "circuit_open",
+                        "circuit open for this cluster bucket after "
+                        "repeated solver failures; retry later",
+                        retry_after_s=retry_after,
+                    )
+                entry = {
+                    "current": current,
+                    "instance": inst,
+                    "seed": options.get("seed", 0),
+                    "trace_id": trace_id,
+                    "budget": budget,
+                    "options": {k: v for k, v in options.items()
+                                if k != "seed"},
+                }
                 return _COALESCER.submit(
-                    key,
-                    {
-                        "current": current,
-                        "instance": inst,
-                        "seed": options.get("seed", 0),
-                        "trace_id": trace_id,
-                        "options": {k: v for k, v in options.items()
-                                    if k != "seed"},
-                    },
-                    wait_s=lock_wait_s,
+                    key, entry, wait_s=lock_wait_s,
                     budget_s=options.get("time_limit_s"),
                 )
 
-        # profiling needs the bucket identity even when the request was
-        # not coalescing-eligible (non-batchable knobs, --max-batch 1):
-        # build the instance now — the solve reuses it — so each bucket
-        # draws on ITS OWN --profile-solves budget, per the contract
-        if solver == "tpu" and OBS["profile_dir"] and bucket_key is None:
+        # the bucket/instance identity is needed even when the request
+        # was not coalescing-eligible (non-batchable knobs, --max-batch
+        # 1, an explicit deadline): the circuit breaker isolates
+        # failures PER BUCKET — one pathological cluster must not open
+        # the circuit for all TPU traffic — each bucket draws on ITS
+        # OWN --profile-solves budget, and each cluster resumes its OWN
+        # checkpoint. Build it now (host-side numpy, milliseconds); the
+        # solve reuses the instance either way
+        if solver_eff == "tpu" and bucket_key is None:
             from .models.instance import build_instance
             from .solvers.tpu import bucket
 
-            inst = build_instance(current, brokers, topology, rf)
+            if inst is None:
+                inst = build_instance(current, brokers, topology, rf)
             bucket_key = (inst.num_brokers, inst.num_racks,
                           *bucket.bucket_shape(inst))
 
         def _solve_job():
             t0 = time.perf_counter()
             kw = dict(options)
-            if solver == "tpu" and bucket_key is not None:
+            left = budget.remaining()
+            if left is not None:
+                if left <= 0.0:
+                    # the queue wait consumed the whole request
+                    # deadline: shed instead of starting a solve whose
+                    # result nobody is waiting for
+                    raise _shed(
+                        "deadline",
+                        "request deadline exhausted before the solve "
+                        "started; retry with a larger deadline_s",
+                        retry_after_s=1.0,
+                        deadline_s=float(deadline_s),
+                    )
+                # remaining-time threading: the solve runs on what is
+                # LEFT of the request deadline, not the full window
+                kw["time_limit_s"] = (
+                    left if kw.get("time_limit_s") is None
+                    else min(float(kw["time_limit_s"]), left)
+                )
+            if solver_eff == "tpu" and inst is not None \
+                    and RESILIENCE["checkpoint_dir"]:
+                # crash-safe auto-resume: the checkpoint path is keyed
+                # by instance fingerprint under the OPERATOR-chosen
+                # directory (clients still cannot name paths); a
+                # worker-crash retry or a repeated solve of the same
+                # cluster warm-starts from the last completed plan
+                import os
+
+                from .utils.checkpoint import instance_fingerprint
+
+                kw["checkpoint"] = os.path.join(
+                    RESILIENCE["checkpoint_dir"],
+                    instance_fingerprint(inst)[:32] + ".npz",
+                )
+            if solver_eff == "tpu" and bucket_key is not None:
                 prof = _profile_dir_for(bucket_key, trace_id)
                 if prof:
                     kw["profile_dir"] = prof
@@ -907,9 +1226,16 @@ def handle_submit(
                 out["trace_id"] = trace_id
             return out
 
-        return _SOLVES.submit(
-            _solve_job, wait_s=lock_wait_s,
-            budget_s=options.get("time_limit_s"),
+        brk_key = (
+            bucket_key if bucket_key is not None
+            else ("solver", solver_eff)
+        )
+        return _breaker_guarded(
+            brk_key,
+            lambda: _SOLVES.submit(
+                _solve_job, wait_s=lock_wait_s,
+                budget_s=options.get("time_limit_s"),
+            ),
         )
     except ApiError:
         raise
@@ -951,10 +1277,11 @@ def handle_evaluate(payload: dict, lock_wait_s: float,
     from .api import evaluate
 
     if not _AUDIT_LOCK.acquire(timeout=lock_wait_s):
-        _count(shed_total=1)
-        raise ApiError(
-            503,
-            f"auditor busy (no capacity within {lock_wait_s:.0f}s); retry later",
+        raise _shed(
+            "audit_busy",
+            f"auditor busy (no capacity within {lock_wait_s:.0f}s); "
+            "retry later",
+            retry_after_s=min(max(lock_wait_s, 1.0), 30.0),
         )
     try:
         out = evaluate(current, brokers, plan, topology, target_rf=rf,
@@ -999,6 +1326,14 @@ def handle_healthz() -> dict:
             "profile_dir": OBS["profile_dir"],
         },
         "sanitizer": _sanitize_mod.snapshot(),
+        "resilience": {
+            "chaos": _chaos.snapshot(),
+            "breaker": _BREAKER.snapshot(),
+            "degradations": _ladder.snapshot(),
+            "default_deadline_s": RESILIENCE["default_deadline_s"],
+            "checkpoint_dir": RESILIENCE["checkpoint_dir"],
+            "queue_wait_s": _SOLVES.queue_wait_s,
+        },
     }
 
 
@@ -1197,11 +1532,14 @@ def start_warmup_thread(shapes: list[dict], *, engine: str = "sweep",
 class Handler(BaseHTTPRequestHandler):
     server_version = "kafka-assignment-optimizer-tpu/1.0"
 
-    def _send(self, status: int, obj: dict) -> None:
+    def _send(self, status: int, obj: dict,
+              headers: dict | None = None) -> None:
         body = json.dumps(obj, default=str).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -1267,6 +1605,10 @@ class Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
         _count(requests_total=1)
+        # chaos slow_client (docs/RESILIENCE.md): a slow client holding
+        # a handler thread — fires before the body read, exactly where
+        # a real trickling upload would stall
+        _chaos.sleep_if("slow_client")
         try:
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -1303,7 +1645,17 @@ class Handler(BaseHTTPRequestHandler):
         except ApiError as e:
             if e.status != 503:
                 _count(errors_total=1)
-            self._send(e.status, {"error": str(e)})
+            headers = None
+            if e.retry_after_s is not None:
+                # integer seconds per RFC 9110; never advertise 0 (a
+                # client retrying immediately defeats the shed)
+                import math
+
+                headers = {
+                    "Retry-After": str(max(1, math.ceil(e.retry_after_s)))
+                }
+            self._send(e.status, {"error": str(e), **e.body_extra},
+                       headers=headers)
         except Exception as e:  # never leak a traceback as a hung socket
             _count(errors_total=1)
             self._send(500, {"error": f"internal error: {e}"})
@@ -1381,6 +1733,41 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="N",
                     help="profiled solves per bucket with "
                          "--profile-dir (default 1)")
+    ap.add_argument("--queue-wait-s", type=float,
+                    default=DEFAULT_QUEUE_WAIT_S,
+                    help="maintenance drain window: how long the "
+                         "periodic cache clear waits for in-flight "
+                         "solves before skipping (was hard-coded 15); "
+                         "echoed in queue-full 503 bodies")
+    ap.add_argument("--default-deadline-s", type=float, default=None,
+                    metavar="S",
+                    help="per-request end-to-end deadline applied when "
+                         "the request carries no 'deadline_s' field "
+                         "(docs/RESILIENCE.md); the solve runs on the "
+                         "time REMAINING after validation and queueing")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="crash-safe auto-resume: persist per-cluster "
+                         "solve checkpoints (keyed by instance "
+                         "fingerprint) under this directory, so a "
+                         "worker-crash retry or a repeated solve of "
+                         "the same cluster warm-starts from the last "
+                         "completed plan")
+    ap.add_argument("--breaker-threshold", type=int, default=3,
+                    metavar="N",
+                    help="consecutive solver failures on one bucket "
+                         "key before its circuit opens (sheds with "
+                         "Retry-After instead of compiling-and-"
+                         "crashing per request)")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                    metavar="S",
+                    help="initial circuit-open cooldown; escalates "
+                         "exponentially (jittered) on repeated trips")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="arm the fault-injection harness (same as "
+                         "KAO_CHAOS; docs/RESILIENCE.md), e.g. "
+                         "'seed=7,pallas_fault,queue_overload:0.5:-1'. "
+                         "NEVER in production: kao_chaos_armed on "
+                         "/metrics exposes it")
     ap.add_argument("--sanitize", action="store_true",
                     help="runtime sanitizer mode (same as "
                          "KAO_SANITIZE=1; docs/ANALYSIS.md): "
@@ -1402,6 +1789,14 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--batch-window-ms must be >= 0")
     if args.max_batch < 1:
         ap.error("--max-batch must be >= 1")
+    if args.queue_wait_s < 0:
+        ap.error("--queue-wait-s must be >= 0")
+    if args.default_deadline_s is not None and args.default_deadline_s <= 0:
+        ap.error("--default-deadline-s must be > 0")
+    if args.breaker_threshold < 1:
+        ap.error("--breaker-threshold must be >= 1")
+    if args.breaker_cooldown_s <= 0:
+        ap.error("--breaker-cooldown-s must be > 0")
     warmup_shapes = None
     if args.warmup:
         try:
@@ -1428,9 +1823,24 @@ def main(argv: list[str] | None = None) -> int:
     OBS["trace"] = not args.no_trace
     OBS["profile_dir"] = args.profile_dir
     OBS["profile_solves"] = args.profile_solves
-    _SOLVES.configure(workers=args.workers, depth=args.queue_depth)
+    _SOLVES.configure(workers=args.workers, depth=args.queue_depth,
+                      queue_wait_s=args.queue_wait_s)
     _COALESCER.configure(window_ms=args.batch_window_ms,
                          max_batch=args.max_batch)
+    RESILIENCE["default_deadline_s"] = args.default_deadline_s
+    if args.checkpoint_dir:
+        import os
+
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        RESILIENCE["checkpoint_dir"] = args.checkpoint_dir
+    _BREAKER.configure(threshold=args.breaker_threshold,
+                       cooldown_s=args.breaker_cooldown_s)
+    if args.chaos:
+        try:
+            _chaos.arm(args.chaos)
+        except ValueError as e:
+            ap.error(str(e))
+        _olog.warn("chaos_armed", spec=args.chaos)
     srv = make_server(
         args.host, args.port, verbose=args.verbose,
         lock_wait_s=args.lock_wait_s,

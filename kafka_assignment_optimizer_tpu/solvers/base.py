@@ -93,13 +93,29 @@ def _load_all() -> None:
             _LOAD_ERRORS[name] = traceback.format_exc(limit=3)
 
 
-def _auto_solve(inst: ProblemInstance, **kw) -> SolveResult:
-    """Exact ILP when the variable space is small enough to be instant;
-    the TPU engine otherwise."""
+def resolve_solver(name: str, inst: ProblemInstance) -> str:
+    """The concrete registry solver ``name`` will run on ``inst``.
+
+    ``"auto"`` resolves deterministically from the instance size (exact
+    ILP when the variable space is small enough to be instant, the TPU
+    engine otherwise); every other name passes through. The serving
+    path keys its per-bucket gates (circuit breaker, checkpoint
+    auto-resume, coalescing, profiling budget) on THIS, not on the
+    requested string — a defaulted ``"auto"`` request at production
+    scale runs the TPU engine and must get the same per-cluster
+    isolation as an explicit ``"solver": "tpu"``."""
+    if name != "auto":
+        return name
     _load_all()
     nvars = 2 * inst.num_brokers * inst.num_parts
     if nvars <= 20_000 or "tpu" not in _REGISTRY:
-        return _REGISTRY["milp"](inst, **kw)
-    return _REGISTRY["tpu"](inst, **kw)
+        return "milp"
+    return "tpu"
+
+
+def _auto_solve(inst: ProblemInstance, **kw) -> SolveResult:
+    """Exact ILP when the variable space is small enough to be instant;
+    the TPU engine otherwise (resolution shared with resolve_solver)."""
+    return _REGISTRY[resolve_solver("auto", inst)](inst, **kw)
 
 

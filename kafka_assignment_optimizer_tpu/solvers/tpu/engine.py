@@ -30,6 +30,9 @@ from ...models import instance as _instance_mod
 from ...models.instance import ProblemInstance
 from ...obs import log as _olog
 from ...obs import trace as _otrace
+from ...resilience import chaos as _chaos
+from ...resilience import ladder as _ladder
+from ...resilience.budget import Budget
 from ...utils import checkpoint as ckpt
 from ..base import SolveResult, register
 from . import arrays
@@ -206,7 +209,23 @@ def solve_tpu(inst: ProblemInstance, *args,
     ring buffer. Default is untraced — zero telemetry overhead — but an
     AMBIENT trace (the serving path wraps each request in one) still
     collects this solve's phase spans; the trace_id then lands in stats
-    so the response can echo it."""
+    so the response can echo it.
+
+    The degradation-rung collector (resilience.ladder) wraps the whole
+    call: every rung any layer takes during this solve — mesh AOT
+    fallbacks, Pallas→XLA retries, the chain-engine retry's own rungs —
+    lands in ``stats["degradations"]`` exactly once, on the outermost
+    solve."""
+    with _ladder.collect() as _rungs:
+        res = _solve_tpu_traced(inst, *args, trace=trace, **kwargs)
+        if _rungs:
+            res.stats["degradations"] = list(_rungs)
+        return res
+
+
+def _solve_tpu_traced(inst: ProblemInstance, *args,
+                      trace: bool | str | None = None,
+                      **kwargs) -> SolveResult:
     tr = _otrace.begin(trace, name="solve_tpu")
     if tr is None:
         try:
@@ -254,6 +273,12 @@ def _solve_tpu(
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
+    # the solve's ONE deadline/retry budget (resilience.budget): every
+    # join, retry and wall-clock gate below asks it for remaining time
+    # instead of re-deriving t0 + time_limit_s arithmetic — which is
+    # what let a timed-out sweep grant its chain retry the full budget
+    # again (satellite fix, ISSUE 6)
+    budget = Budget(time_limit_s, t0=t0)
     # double-buffered ladder dispatch (docs/PIPELINE.md): None defers
     # to the process default (--no-pipeline / KAO_NO_PIPELINE flip it)
     pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
@@ -383,12 +408,26 @@ def _solve_tpu(
     else:
         lp_fut = None
         lp_wait_s = 0.0
-    res = _solve_tpu_inner(
-        inst, seed, batch, rounds, sweeps, steps_per_round, t_hi, t_lo,
-        n_devices, engine, checkpoint, profile_dir, time_limit_s,
-        backend_fut, t0, bounds_fut,
-        cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
-    )
+    try:
+        res = _solve_tpu_inner(
+            inst, seed, batch, rounds, sweeps, steps_per_round, t_hi,
+            t_lo, n_devices, engine, checkpoint, profile_dir,
+            time_limit_s, backend_fut, t0, bounds_fut,
+            cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
+            budget,
+        )
+    except Exception as e:
+        # the degradation ladder's last rung (docs/RESILIENCE.md): a
+        # fault that makes the DEVICE path unusable must still return a
+        # valid, oracle-verified plan — the host greedy/reseat
+        # constructor, flagged degraded — instead of failing the
+        # request. Deliberately narrow (_degradable): sanitizer trips
+        # keep failing loudly, multi-controller workers must not
+        # diverge, and precompile solves exist to exercise the device.
+        if multi or precompile or not _degradable(e):
+            raise
+        res = _host_fallback(inst, e, checkpoint, budget, t0,
+                             time_limit_s)
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
     # per-partition diversity at high RF) can defeat its conflict-
@@ -404,19 +443,20 @@ def _solve_tpu(
         # SPMD: workers must agree on retrying; the inner solve ignores
         # the deadline under multi anyway, so only the data-determined
         # conditions above (identical on every worker) may decide
-        and (multi or time_limit_s is None
-             or _budget_left(t0, time_limit_s) > 0)
+        and (multi or not budget.expired())
     ):
-        remaining = (
-            None if time_limit_s is None
-            else _budget_left(t0, time_limit_s)
-        )
+        # the retry runs on what is LEFT of this solve's budget — never
+        # the original time_limit_s (a timed-out sweep must not grant
+        # the chain retry the full window again)
+        remaining = budget.remaining()
         # engine-neutral knobs carry over; the budget knobs
         # (rounds/sweeps/steps_per_round) deliberately do NOT — each
         # engine's budget is meaningless for the other (see _defaults),
         # so the retry runs the chain engine's own defaults. Under an
         # active trace the retry's pipeline spans nest under this
         # "retry" span, keeping the root-level phases exactly-once.
+        _ladder.note_rung("sweep_to_chain", parts=inst.num_parts,
+                          remaining_s=remaining)
         _olog.warn("engine_fallback_retry", engine="chain",
                    parts=inst.num_parts)
         with _otrace.span("retry", engine="chain"):
@@ -450,11 +490,106 @@ def _solve_tpu(
     return res
 
 
-def _budget_left(t0: float, time_limit_s: float | None) -> float | None:
-    """Remaining deadline budget in seconds (None = no deadline)."""
-    if time_limit_s is None:
-        return None
-    return max(0.0, t0 + time_limit_s - time.perf_counter())
+def _chaos_chunk_hooks() -> None:
+    """The chaos injection points every chunk dispatch fires — host
+    side, before anything is traced or donated (docs/RESILIENCE.md): a
+    Pallas kernel fault (drained and retried on XLA), a NaN surfacing
+    from the chunk (the host-fallback rung when the sanitizer is off),
+    and a chunk overrun (exercises the deadline gate). ONE helper so
+    the single-solve and batch ladders can never drift apart on which
+    faults the chaos soak exercises."""
+    _chaos.raise_if("pallas_fault")
+    _chaos.raise_if("nan_chunk", FloatingPointError)
+    _chaos.sleep_if("chunk_overrun")
+
+
+def _is_pallas_lowering(e: Exception, scorer: str) -> bool:
+    """Only a Mosaic/Pallas lowering failure warrants the XLA retry;
+    anything else (OOM, sharding bug, regression) must surface with
+    its real traceback. The injected chaos pallas fault qualifies
+    regardless of the active scorer, so CPU test meshes exercise the
+    same drain-and-retry path real hardware takes."""
+    if _chaos.is_pallas_fault(e):
+        return True
+    msg = f"{type(e).__name__}: {e}"
+    return scorer == "pallas" and any(
+        s in msg for s in ("Mosaic", "mosaic", "pallas", "Pallas",
+                           "lowering", "Lowering")
+    )
+
+
+def _degradable(e: BaseException) -> bool:
+    """Faults that warrant the host-fallback rung instead of failing
+    the solve: injected chaos faults, and device NaN aborts when the
+    sanitizer is NOT armed (armed means the operator asked for loud
+    failure — docs/ANALYSIS.md). Everything else (OOM, sharding bugs,
+    regressions) must surface with its real traceback."""
+    if isinstance(e, _san.SanitizerError):
+        return False
+    if _chaos.is_fault(e):
+        return True
+    return isinstance(e, FloatingPointError) and not _san.enabled()
+
+
+def _host_fallback(inst: ProblemInstance, exc: BaseException,
+                   checkpoint: str | None, budget: Budget, t0: float,
+                   time_limit_req: float | None) -> SolveResult:
+    """The ladder's terminal rung (``anneal_to_construct``): the device
+    search is unusable, so build the best host-side plan — greedy
+    repair, displaced by a higher-ranking checkpoint when one exists
+    (crash-resume), lifted by the exact leader reseat when feasible —
+    verify it against the numpy oracle, and return it FLAGGED
+    (``stats["degraded"]``) so callers can tell a degraded plan from a
+    searched one. Certification is still attempted (budget permitting):
+    on slack-caps instances greedy + exact reseat often IS the proven
+    optimum, in which case the degraded plan is also certified."""
+    _ladder.note_rung("anneal_to_construct", error=repr(exc)[:200])
+    a = np.asarray(greedy_seed(inst), dtype=np.int32)
+    resumed = False
+    if checkpoint:
+        a_prev = ckpt.load(checkpoint, inst)
+        if a_prev is not None:
+            def rank(zz):
+                pen = sum(inst.violations(zz).values())
+                return (pen == 0, -pen, inst.preservation_weight(zz))
+
+            if rank(a_prev) >= rank(a):
+                a = a_prev
+                resumed = True
+    if inst.is_feasible(a) and not budget.expired():
+        a = inst.best_leader_assignment(a)
+    viol = inst.violations(a)
+    feasible = all(v == 0 for v in viol.values())
+    weight = inst.preservation_weight(a)
+    proved = False
+    if feasible and not budget.expired():
+        try:
+            proved = inst.certify_optimal(a, allow_tight=False)
+        except Exception:
+            proved = False
+    return SolveResult(
+        a=a,
+        solver="tpu",
+        wall_clock_s=time.perf_counter() - t0,
+        objective=int(weight),
+        optimal=proved,
+        stats={
+            "engine": "host_fallback",
+            "degraded": "anneal_to_construct",
+            "fault": repr(exc)[:200],
+            "feasible": feasible,
+            "violations": sum(viol.values()),
+            "moves": int(inst.move_count(a)),
+            "seed_moves": int(inst.move_count(a)),
+            "proved_optimal": proved,
+            "resumed_from_checkpoint": resumed,
+            "time_limit_s": time_limit_req,
+            "timed_out": False,
+            "early_stopped": False,
+            "constructed": True,
+            "rounds_run": 0,
+        },
+    )
 
 
 def _process_count() -> int:
@@ -621,7 +756,7 @@ class _BoundsTask:
         return self._res
 
 
-def _await_constructor(lp_fut, lp_wait_s, checkpoint, t0, time_limit_s):
+def _await_constructor(lp_fut, lp_wait_s, checkpoint, budget: Budget):
     """Stage 1 — the constructor race: join the LP/MILP/reseat worker
     for up to ``lp_wait_s``. A certified plan makes annealing — and with
     it the greedy seed, the device model arrays and the schedule —
@@ -641,19 +776,17 @@ def _await_constructor(lp_fut, lp_wait_s, checkpoint, t0, time_limit_s):
         from pathlib import Path
 
         Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
-    budget = _budget_left(t0, time_limit_s)
     # per-worker adaptive wait, chosen by solve_tpu when it picked the
     # racer (45 s past the aggregation threshold, a 15 s middle tier
-    # for the mid-size reseat racer, 5 s otherwise). Every constructor
-    # worker returns the uniform 3-tuple (plan, ok, extends_greedy), so
-    # the unpack is strict — a wrong-arity worker is a bug, and the
-    # except below turns it into "no constructed plan", never a crash.
+    # for the mid-size reseat racer, 5 s otherwise), capped by the
+    # solve budget. Every constructor worker returns the uniform
+    # 3-tuple (plan, ok, extends_greedy), so the unpack is strict — a
+    # wrong-arity worker is a bug, and the except below turns it into
+    # "no constructed plan", never a crash.
     lp_warm_extends = False
     try:
         plan, ok, lp_warm_extends = lp_fut.result(
-            timeout=(
-                lp_wait_s if budget is None else min(lp_wait_s, budget)
-            )
+            timeout=budget.cap(lp_wait_s)
         )
         lp_warm_extends = bool(lp_warm_extends)
     except Exception:
@@ -690,7 +823,7 @@ class _LadderResult:
 def _run_ladder(
     inst, m, mesh, chains_per_device, rounds, steps_per_round, engine,
     scorer, chunks, seed_dev, key, sweep_state, lp_fut, bounds_fut,
-    multi, cert_min_savings_s, t0, time_limit_s, profile_dir,
+    multi, cert_min_savings_s, budget, profile_dir,
     polish_starter=None, pipeline=True, warm_key=(),
 ) -> _LadderResult:
     """Stage 4 — the chunked annealing ladder: dispatch each schedule
@@ -724,7 +857,7 @@ def _run_ladder(
     r = _LadderResult(scorer=scorer)
     n = len(chunks)
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
-    deadline = None if time_limit_s is None else t0 + time_limit_s
+    deadline = budget.deadline
     # chunk 0's duration is compile-inclusive and a fallback chunk's
     # includes the XLA retry's first compile — both wildly overstate a
     # warm chunk, so neither may feed the warm estimate (a cold solve
@@ -757,7 +890,9 @@ def _run_ladder(
     def dispatch(i, st):
         """Enqueue chunk i on the device; returns without waiting for
         the result (past any compile). Timed internally so a retry
-        after a Pallas fallback times the successful dispatch only."""
+        after a Pallas fallback times the successful dispatch only.
+        Chaos injection points fire HERE (_chaos_chunk_hooks)."""
+        _chaos_chunk_hooks()
         td = time.perf_counter()
         out = solve_on_mesh(
             m, seed_dev, subs[i], mesh, chains_per_device, rounds,
@@ -771,17 +906,13 @@ def _run_ladder(
         return new_state, pop_a, pop_k, curve, time.perf_counter() - td
 
     def _is_lowering(e: Exception) -> bool:
-        # only a Mosaic/Pallas lowering failure warrants the XLA retry;
-        # anything else (OOM, sharding bug, regression) must surface
-        # with its real traceback
-        msg = f"{type(e).__name__}: {e}"
-        return r.scorer == "pallas" and any(
-            s in msg for s in ("Mosaic", "mosaic", "pallas", "Pallas",
-                               "lowering", "Lowering")
-        )
+        # r.scorer is read at CALL time: after a fallback flips it to
+        # "xla" a second Mosaic-looking failure must surface for real
+        return _is_pallas_lowering(e, r.scorer)
 
     def _note_fallback(i, e) -> None:
         nonlocal warm_chunk_s, prior_s
+        _ladder.note_rung("pallas_to_xla", chunk=i)
         r.pallas_fallback = repr(e)[:500]
         r.scorer = "xla"
         # scorer-pure estimates: Pallas chunks are materially faster
@@ -1066,6 +1197,7 @@ def _run_ladder(
                 # chunk synchronously (compiles the XLA solver — the
                 # chunk is warm-estimate-excluded like chunk 0), then
                 # speculation resumes from the next iteration
+                _ladder.note_rung("pipelined_to_sync", chunk=i + 1)
                 pending, _ = dispatch_or_fallback(i + 1, sweep_state)
                 pend_fb = True
             i += 1
@@ -1180,8 +1312,8 @@ def _build_chunks(inst, engine, rounds, t_hi, t_lo, time_limit_s):
 
 
 def _final_selection(
-    inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut, t0,
-    time_limit_s, multi,
+    inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
+    budget, multi,
 ):
     """Stage 5 — final selection: exact-rescore the per-shard winners on
     device (the Pallas kernel on TPU, XLA elsewhere) and rank by
@@ -1218,15 +1350,15 @@ def _final_selection(
     )]
     certified_final = None
     final_cert = "budget_spent"  # why the attempt concluded
-    budget = _budget_left(t0, time_limit_s)
-    if budget is None or budget > 0:
+    left = budget.remaining()
+    if left is None or left > 0:
         # cap the pre-polish join so an instance with a straggling
         # bounds ladder AND a real optimality gap keeps the old overlap
         # (polish runs while the LPs finish; the post-polish join below
         # still waits). Under multi-controller SPMD the join must stay
         # unbounded: a wall-clock cap could resolve differently per
         # worker and diverge the control flow.
-        join_cap = budget if (multi or budget is not None) else 15.0
+        join_cap = left if (multi or left is not None) else 15.0
         try:
             lb_exact, ub0 = bounds_fut.result(timeout=join_cap)
         except Exception:
@@ -1269,9 +1401,9 @@ def _final_selection(
         # of the same executable here); any AOT mismatch (sharding,
         # aval) falls back to the jitted path below
         try:
-            budget = _budget_left(t0, time_limit_s)
+            left = budget.remaining()
             pol = polish_fut.result(
-                timeout=60.0 if budget is None else max(budget, 0.0)
+                timeout=60.0 if left is None else left
             )
         except Exception:
             pol = polish_jit
@@ -1280,17 +1412,16 @@ def _final_selection(
     except Exception:
         best_a = polish_jit(m, cand)
     best_a = arrays.unpad_candidate(best_a, inst)
-    budget = _budget_left(t0, time_limit_s)
     try:
         # join bounded by the remaining deadline budget: when the
         # ladder outlasted the prefetch (the usual case) this is free,
         # but a timed-out solve must not stall on a straggling LP
-        _, ub0 = bounds_fut.result(timeout=budget)
+        _, ub0 = bounds_fut.result(timeout=budget.remaining())
     except Exception:
         ub0 = None
     if (
         inst.is_feasible(best_a)
-        and (budget is None or budget > 0)  # deadline left
+        and not budget.expired()  # deadline left
         and (ub0 is None or inst.preservation_weight(best_a) < ub0)
     ):
         # below the weight bound: exact leader reseat (zero replica
@@ -1300,12 +1431,12 @@ def _final_selection(
     if lp_fut is not None:
         # even an uncertified constructed plan may outrank the annealed
         # one — compare under the solve's lexicographic objective
-        # (feasible, weight, fewest moves). Recompute the budget: the
+        # (feasible, weight, fewest moves). Re-ask the budget: the
         # bounds join above may have consumed the last of it
-        budget = _budget_left(t0, time_limit_s)
+        left = budget.remaining()
         try:
             plan, _ok, _extends = lp_fut.result(
-                timeout=10.0 if budget is None else budget
+                timeout=10.0 if left is None else left
             )
         except Exception:
             plan = None
@@ -1329,13 +1460,15 @@ def _solve_tpu_inner(
     n_devices, engine, checkpoint, profile_dir, time_limit_s,
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
     lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
-    pipeline=True,
+    pipeline=True, budget: Budget | None = None,
 ) -> SolveResult:
     timed_out = False
     early_stopped = False
     constructed = False
     final_cert = None  # certify-first outcome at final selection
     rounds_run = 0
+    if budget is None:
+        budget = Budget(time_limit_s, t0=t0)
     # multi-controller SPMD (see solve_tpu): per-process wall-clock
     # budgets would let workers diverge — in front of collectives
     # (deadlock) or at the final bound joins (disagreeing plans) — so
@@ -1344,6 +1477,7 @@ def _solve_tpu_inner(
     time_limit_req = time_limit_s
     if multi:
         time_limit_s = None
+        budget = Budget(None, t0=t0)
 
     # pipeline phase spans (obs.trace): every stage gets exactly one
     # span on every path — stages that do not run emit a zero-duration
@@ -1352,7 +1486,7 @@ def _solve_tpu_inner(
     # every solve report regardless of which shortcut fired
     with _otrace.span("constructor") as _sp:
         certified_a, lp_warm, lp_warm_extends = _await_constructor(
-            lp_fut, lp_wait_s, checkpoint, t0, time_limit_s
+            lp_fut, lp_wait_s, checkpoint, budget
         )
         if _sp is not None:
             _sp.set(
@@ -1521,7 +1655,7 @@ def _solve_tpu_inner(
             lad = _run_ladder(
                 inst, m, mesh, chains_per_device, rounds, steps_per_round,
                 engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
-                bounds_fut, multi, cert_min_savings_s, t0, time_limit_s,
+                bounds_fut, multi, cert_min_savings_s, budget,
                 profile_dir, polish_starter=_start_polish_aot,
                 pipeline=pipeline, warm_key=warm_key,
             )
@@ -1545,6 +1679,11 @@ def _solve_tpu_inner(
     tight_fut = lad.tight_fut
     rounds_run += lad.rounds_run
     timed_out = timed_out or lad.timed_out
+    if lad.timed_out:
+        # deadline rung: the ladder returned best-so-far early — a
+        # degradation in search depth, recorded like every other rung
+        _ladder.note_rung("deadline_truncated",
+                          rounds_run=lad.rounds_run)
     if lad.certified_a is not None:
         certified_a = lad.certified_a
         early_stopped = True
@@ -1584,7 +1723,7 @@ def _solve_tpu_inner(
         with _otrace.span("polish") as _sp:
             best_a, final_cert, lp_won = _final_selection(
                 inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
-                t0, time_limit_s, multi,
+                budget, multi,
             )
             if _sp is not None:
                 _sp.set(final_cert=final_cert, lp_plan_won=lp_won)
@@ -1601,18 +1740,27 @@ def _solve_tpu_inner(
         if checkpoint:
             # persist BEFORE the certification joins below: with no
             # deadline they may block on a straggling LP, and a solve
-            # killed in that window must not lose its plan
-            ckpt.save(
-                checkpoint,
-                inst,
-                best_a,
-                meta={
-                    "objective": int(weight),
-                    "feasible": feasible,
-                    "moves": moves_final,
-                    "engine": engine,
-                },
-            )
+            # killed in that window must not lose its plan. A write
+            # FAILURE (disk full, permissions, the chaos injection
+            # point) degrades to checkpoint-skipped — the solve already
+            # holds a verified plan and must return it, not die on
+            # persistence (docs/RESILIENCE.md)
+            try:
+                _chaos.raise_if("checkpoint_write", OSError)
+                ckpt.save(
+                    checkpoint,
+                    inst,
+                    best_a,
+                    meta={
+                        "objective": int(weight),
+                        "feasible": feasible,
+                        "moves": moves_final,
+                        "engine": engine,
+                    },
+                )
+            except Exception as e:
+                _ladder.note_rung("checkpoint_skipped",
+                                  error=repr(e)[:200])
         # optimality certificate: when the final plan meets both bounds
         # it is a PROVEN global optimum (weight is the primary
         # objective, moves the tie-break, and no feasible plan can beat
@@ -1626,7 +1774,7 @@ def _solve_tpu_inner(
             proved_optimal = True
         else:
             try:
-                timeout = _budget_left(t0, time_limit_s)
+                timeout = budget.remaining()
                 bounds_fut.result(timeout=timeout)
                 if tight_fut is not None:
                     # a tier-1 LP is already running on the worker: join
@@ -1734,7 +1882,24 @@ def _solve_tpu_inner(
     )
 
 
-def solve_tpu_batch(
+def solve_tpu_batch(*args, **kwargs) -> list[SolveResult]:
+    """Batched entry point — see :func:`_solve_tpu_batch_impl` for the
+    full contract. Wraps the implementation in the degradation-rung
+    collector (resilience.ladder): rungs taken by the SHARED batched
+    dispatch apply to every lane, while a lane's own sequential
+    fallback (collected lane-scoped inside the impl) lands on that
+    lane's ``stats["degradations"]`` only — seven clean lanes must not
+    read as degraded because the eighth fell back."""
+    with _ladder.collect() as _rungs:
+        results = _solve_tpu_batch_impl(*args, **kwargs)
+        for r in results:
+            combined = list(_rungs or ()) + r.stats.get("degradations", [])
+            if combined:
+                r.stats["degradations"] = combined
+        return results
+
+
+def _solve_tpu_batch_impl(
     insts: list,
     seeds: int | list[int] = 0,
     *,
@@ -1819,12 +1984,17 @@ def solve_tpu_batch(
                 # each sequential solve's pipeline spans nest under a
                 # per-lane span, keeping the shared report readable
                 with _otrace.span("lane", index=i):
-                    r = solve_tpu(inst, seed=s, engine=engine,
-                                  batch=batch, rounds=rounds,
-                                  sweeps=sweeps, t_hi=t_hi, t_lo=t_lo,
-                                  n_devices=n_devices,
-                                  time_limit_s=time_limit_s,
-                                  pipeline=pipeline)
+                    # lane-scoped rung collection: THIS lane's
+                    # fallbacks must not flag its siblings' stats
+                    with _ladder.collect_lane() as lane_rungs:
+                        r = solve_tpu(inst, seed=s, engine=engine,
+                                      batch=batch, rounds=rounds,
+                                      sweeps=sweeps, t_hi=t_hi,
+                                      t_lo=t_lo, n_devices=n_devices,
+                                      time_limit_s=time_limit_s,
+                                      pipeline=pipeline)
+                if lane_rungs:
+                    r.stats["degradations"] = list(lane_rungs)
                 r.stats["lane_fallback"] = (
                     "brokers/racks differ across lanes"
                 )
@@ -1935,7 +2105,7 @@ def _solve_batch_body(
     # boundary, exactly like the single path's reseed)
     from ...parallel.mesh import fetch_global_async
 
-    deadline = None if time_limit_s is None else t0 + time_limit_s
+    deadline = Budget(time_limit_s, t0=t0).deadline
     chunks = _build_chunks(biggest, engine, rounds, t_hi, t_lo,
                            time_limit_s)
     n = len(chunks)
@@ -1964,7 +2134,9 @@ def _solve_batch_body(
 
     def dispatch(ci, st):
         """Enqueue chunk ci (no wait); timed internally so a fallback
-        retry times the successful dispatch only."""
+        retry times the successful dispatch only. Same chaos points as
+        the single path (_chaos_chunk_hooks: host side, never traced)."""
+        _chaos_chunk_hooks()
         td = time.perf_counter()
         out = solve_lanes(
             m_stack, mesh, chains_per_device, chunks[ci], state=st,
@@ -1978,14 +2150,12 @@ def _solve_batch_body(
         return new_state, pa, pk, cv, time.perf_counter() - td
 
     def _is_lowering(e):
-        msg = f"{type(e).__name__}: {e}"
-        return scorer == "pallas" and any(
-            s in msg for s in ("Mosaic", "mosaic", "pallas", "Pallas",
-                               "lowering", "Lowering")
-        )
+        # scorer is read at CALL time (see the single path's note)
+        return _is_pallas_lowering(e, scorer)
 
     def _note_fb(ci, e):
         nonlocal scorer, pallas_fallback, warm_chunk_s, prior_s
+        _ladder.note_rung("pallas_to_xla", chunk=ci)
         pallas_fallback = repr(e)[:500]
         scorer = "xla"
         # restart the warm measurement under the new scorer key (see
@@ -2122,6 +2292,7 @@ def _solve_batch_body(
             else:
                 # drained at a Pallas fallback: synchronous XLA retry,
                 # then the pipeline re-enters
+                _ladder.note_rung("pipelined_to_sync", chunk=ci + 1)
                 pending, _ = dispatch_or_fallback(ci + 1, state)
                 pend_fb = True
             ci += 1
@@ -2135,6 +2306,8 @@ def _solve_batch_body(
         if _lsp is not None:
             _lsp.set(rounds_run=rounds_run, timed_out=timed_out,
                      scorer=scorer, pipelined=pipelined)
+    if timed_out:
+        _ladder.note_rung("deadline_truncated", rounds_run=rounds_run)
     if warm_chunk_s is not None:
         _WARM_CHUNKS.update(_wkey(), warm_chunk_s)
     t_solve = time.perf_counter()
