@@ -139,6 +139,7 @@ def resolve_backend() -> tuple[dict, str, str | None]:
 def _run_child(
     args: argparse.Namespace, name: str, env: dict, warmrun: bool,
     kernel: bool = False, batch_bench: bool = False,
+    replay_day: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -151,6 +152,8 @@ def _run_child(
         cmd.append("--warm")
     if batch_bench:
         cmd.append("--batch-bench")
+    if replay_day:
+        cmd.append("--replay-day")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -453,6 +456,259 @@ def run_batch_throughput(smoke: bool, seed: int) -> dict:
     }
 
 
+def _pctile(xs: list, q: float) -> float | None:
+    """Nearest-rank percentile of a small latency sample."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return round(xs[k], 4)
+
+
+def _replay_day_script(smoke: bool) -> tuple:
+    """The scripted event day (docs/WATCH.md): a rolling two-broker
+    decommission, partition growth, a rack loss + recovery, then an
+    event storm. Returns ``(cluster_id, bootstrap_event,
+    sequential_events, storm_events)`` — epochs pre-assigned, storm
+    epochs contiguous after the sequence."""
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    B = 12 if smoke else 64
+    n_racks = 4
+    ppt = 10 if smoke else 40
+    topics = {f"t{i}": ppt for i in range(4 if smoke else 20)}
+    rf = 3
+    brokers = list(range(B))
+    topo = gen._mod_topology(brokers, n_racks)
+    current = gen.balanced_assignment(brokers, topo, topics, rf)
+    fail_rack = topo.rack(1)
+    failed = [b for b in brokers if topo.rack(b) == fail_rack
+              and b not in (B - 1, B - 2)]
+    bootstrap = {
+        "type": "bootstrap", "epoch": 1,
+        "assignment": current.to_dict(), "brokers": brokers,
+        "topology": topo.to_dict(), "rf": rf,
+    }
+    seq = [
+        # a rolling decommission: drain, drain, forget
+        {"type": "broker_drain", "epoch": 2, "brokers": [B - 1]},
+        {"type": "broker_drain", "epoch": 3, "brokers": [B - 2]},
+        {"type": "broker_remove", "epoch": 4, "brokers": [B - 1, B - 2]},
+        # a topic grows mid-day
+        {"type": "partition_growth", "epoch": 5, "topic": "t0",
+         "add": ppt // 2},
+        # a rack fails...
+        {"type": "rack_fail", "epoch": 6, "rack": fail_rack},
+        # ...and comes back
+        {"type": "broker_add", "epoch": 7, "brokers": failed,
+         "racks": {str(b): fail_rack for b in failed}},
+    ]
+    # the storm: a controller rapid-fires flap events while the first
+    # one's solve is still in flight — the registry must coalesce them
+    # into ONE re-solve of the latest state and drop none
+    storm = []
+    e = 8
+    for _ in range(5):
+        storm.append({"type": "broker_drain", "epoch": e, "brokers": [0]})
+        storm.append({"type": "broker_add", "epoch": e + 1, "brokers": [0]})
+        e += 2
+    return "replay-day", bootstrap, seq, storm
+
+
+def run_replay_day(smoke: bool, seed: int) -> dict:
+    """The event-day replay harness (ISSUE 7 tentpole evidence): ONE
+    scripted day of cluster events through the watch state machine on
+    the warm product path (each delta solve seeded by the previous
+    plan via ``optimize_delta``), with a PAIRED shadow cold solve of
+    the IDENTICAL cluster state at every sequential event. Pairing is
+    what makes the per-event comparison meaningful: a two-arm design
+    (one warm stream, one cold stream) lets the arms' incumbent states
+    diverge at the first uncertified event — each arm's next event
+    diffs against its OWN previous plan — after which per-event move
+    counts and objectives compare annealer luck on different
+    instances, not warm-starting. The storm segment runs on the warm
+    stream only (its gate is coalescing with zero drops, not plan
+    quality). Reports per-event end-to-end latency (p50/p99, paired),
+    plan quality, and move counts."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    import threading
+    from dataclasses import replace as _dc_replace
+
+    import jax
+
+    from kafka_assignment_optimizer_tpu.api import optimize_delta
+    from kafka_assignment_optimizer_tpu.models.cluster import Assignment
+    from kafka_assignment_optimizer_tpu.watch.events import apply_event
+    from kafka_assignment_optimizer_tpu.watch.manager import WatchRegistry
+
+    cid, bootstrap, seq, storm = _replay_day_script(smoke)
+    limit_s = 60.0 if smoke else 300.0
+
+    def solve_once(state, prev_plan, budget=None):
+        return optimize_delta(
+            state.assignment, state.brokers, state.topology,
+            target_rf=state.rf, prev_plan=prev_plan,
+            solver="tpu", seed=seed, budget=budget,
+            time_limit_s=limit_s,
+        )
+
+    def row_of(ev: dict, rep: dict, dt: float) -> dict:
+        return {
+            "type": ev["type"], "epoch": ev["epoch"],
+            "wall_s": round(dt, 4),
+            "moves": rep.get("replica_moves"),
+            "feasible": rep.get("feasible"),
+            "proved": rep.get("proven_optimal"),
+            "warm_started": bool(rep.get("solver_warm_started")),
+            "objective": rep.get("objective_weight"),
+            "objective_ub": rep.get("objective_upper_bound"),
+        }
+
+    # unmeasured warmup pass: both measured columns must see warmed
+    # jit/executable caches — without it the first solves pay every
+    # compile and the comparison measures XLA, not warm-starting
+    mirror = None
+    for ev in [bootstrap] + seq:
+        mirror = apply_event(mirror, cid, ev)
+        res = solve_once(mirror, None)
+        mirror = _dc_replace(mirror, assignment=res.assignment)
+
+    storm_hold: dict = {"gate": None}
+
+    def solve_fn(state, prev_plan, budget):
+        res = solve_once(state, prev_plan, budget)
+        gate = storm_hold["gate"]
+        if gate is not None:
+            # storm segment: the first in-flight solve is held open
+            # until the whole burst has been fired, so the coalescing
+            # evidence is deterministic — not a race between a sleep
+            # and however fast this machine happens to solve
+            gate.wait(timeout=30)
+        return res.assignment.to_dict(), res.report()
+
+    reg = WatchRegistry(solve_fn, None, window_s=0.05,
+                        max_backlog=1024)
+    warm_rows: list[dict] = []
+    cold_rows: list[dict] = []
+    warm_lat: list[float] = []
+    cold_lat: list[float] = []
+    mirror = None
+    for ev in [bootstrap] + seq:
+        # the state this event's solve will see, mirrored through the
+        # same pure transition the registry applies
+        mirror = apply_event(mirror, cid, ev)
+        t0 = time.perf_counter()
+        out = reg.handle_event(cid, ev)
+        dt = time.perf_counter() - t0
+        warm_lat.append(dt)
+        warm_rows.append(row_of(ev, out.get("report") or {}, dt))
+        # paired shadow: the SAME cluster state, solved from scratch
+        # (outside the stream, so it never pollutes the warm latency)
+        t0 = time.perf_counter()
+        cres = solve_once(mirror, None)
+        cdt = time.perf_counter() - t0
+        cold_lat.append(cdt)
+        cold_rows.append(row_of(ev, cres.report(), cdt))
+        # the stream carries the warm plan forward, and so must the
+        # mirror the next event's transition starts from
+        mirror = _dc_replace(mirror, assignment=Assignment.from_dict(
+            out["assignment"]))
+    # storm segment: thread A's event takes the solver role; the
+    # rapid-fire rest must coalesce behind it (202-equivalent acks).
+    # The gate holds A's solve open until the burst has been fired.
+    first, rest = storm[0], storm[1:]
+    storm_hold["gate"] = threading.Event()
+    t_storm = time.perf_counter()
+    a = threading.Thread(target=reg.handle_event, args=(cid, first))
+    a.start()
+    # fire the burst only once A actually HOLDS the solver role —
+    # otherwise the first burst event would take it on this thread and
+    # wait on a gate only this thread can set
+    role_deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < role_deadline:
+        if (reg.get_cluster(cid) or {}).get("solving"):
+            break
+        time.sleep(0.001)
+    acks = 0
+    ack_lat: list[float] = []
+    for ev in rest:
+        t0 = time.perf_counter()
+        out = reg.handle_event(cid, ev)
+        ack_lat.append(time.perf_counter() - t0)
+        acks += int(out.get("status") == "accepted")
+    storm_hold["gate"].set()
+    storm_hold["gate"] = None  # the drain re-solve runs unheld
+    a.join()
+    deadline = time.perf_counter() + limit_s * 4
+    while time.perf_counter() < deadline:
+        info = reg.get_cluster(cid)
+        if not info["solving"] and info["pending_events"] == 0:
+            break
+        time.sleep(0.05)
+    storm_s = time.perf_counter() - t_storm
+    info = reg.get_cluster(cid)
+    snap = reg.snapshot()
+    last_epoch = storm[-1]["epoch"]
+
+    def arm(rows: list[dict], lat: list[float]) -> dict:
+        solves = [r for r in rows if r["moves"] is not None]
+        # percentiles over the DELTA events only: the bootstrap solve
+        # is identical in both columns by construction (no previous
+        # plan to warm from), so including it just parks noise at the
+        # median of a 7-sample set
+        delta_lat = lat[1:]
+        return {
+            "p50_s": _pctile(delta_lat, 50),
+            "p99_s": _pctile(delta_lat, 99),
+            "latencies_s": [round(x, 4) for x in lat],
+            "rows": rows,
+            "certified_events": sum(1 for r in solves if r["proved"]),
+            "all_feasible": all(r["feasible"] for r in solves),
+            "moves_total": sum(r["moves"] for r in solves),
+        }
+
+    warm = arm(warm_rows, warm_lat)
+    cold = arm(cold_rows, cold_lat)
+    warm["warm_solves"] = snap["warm_solves_total"]
+    warm["storm"] = {
+        "acks_coalesced": acks,
+        "ack_latencies_s": [round(x, 4) for x in ack_lat],
+        "sheds": snap["storm_sheds_total"],
+        "superseded": snap["superseded_total"],
+        "drain_s": round(storm_s, 3),
+        "final_epoch": info["epoch"],
+        "final_plan_epoch": info["plan_epoch"],
+    }
+    # quality gate, per paired event (identical instance on both
+    # sides): feasible, certified whenever the shadow cold solve
+    # certified, and an at-least-as-good objective; across the day,
+    # the warm stream must not move more data in total
+    quality_ok = all(
+        w["feasible"] and (not c["proved"] or w["proved"])
+        and (w["objective"] is None or c["objective"] is None
+             or w["objective"] >= c["objective"])
+        for w, c in zip(warm["rows"], cold["rows"])
+    ) and warm["moves_total"] <= cold["moves_total"]
+    dropped = (
+        warm["storm"]["sheds"]
+        + int(warm["storm"]["final_plan_epoch"] != last_epoch)
+    )
+    return {
+        "platform": jax.devices()[0].platform,
+        "events": len(seq) + 1 + len(storm),
+        "warm": warm,
+        "cold": cold,
+        "latency_win": (
+            warm["p50_s"] is not None and cold["p50_s"] is not None
+            and warm["p50_s"] < cold["p50_s"]
+        ),
+        "quality_ok": quality_ok,
+        "storm_dropped": dropped,
+    }
+
+
 def run_kernel_bench(smoke: bool) -> dict:
     """Time the Pallas scoring kernel (compiled, interpret=False) against
     the pure-XLA scorer on a production-shaped batch. TPU-only: on CPU
@@ -463,6 +719,10 @@ def run_kernel_bench(smoke: bool) -> dict:
 
 
 def child_main(args: argparse.Namespace) -> int:
+    if args.replay_day:
+        out = run_replay_day(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
     if args.batch_bench:
         out = run_batch_throughput(args.smoke, args.seed)
         print("RESULT " + json.dumps(out))
@@ -528,6 +788,29 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
     ]
 
 
+def _compact_replay(rb: dict | None, err: str | None) -> dict:
+    """The replay-day block of the stdout line: the warm-vs-cold
+    latency split, the per-event quality verdict, and the storm-segment
+    coalescing evidence — enough to audit the ISSUE 7 acceptance
+    criteria from the artifact alone."""
+    if rb is None:
+        return {"error": (err or "failed")[:120]}
+    w, c = rb["warm"], rb["cold"]
+    return {
+        "events": rb["events"],
+        "warm_p50_s": w["p50_s"], "warm_p99_s": w["p99_s"],
+        "cold_p50_s": c["p50_s"], "cold_p99_s": c["p99_s"],
+        "latency_win": rb["latency_win"],
+        "quality_ok": rb["quality_ok"],
+        "warm_solves": w["warm_solves"],
+        "warm_certified": w["certified_events"],
+        "cold_certified": c["certified_events"],
+        "warm_moves": w["moves_total"], "cold_moves": c["moves_total"],
+        "storm_coalesced": w["storm"]["acks_coalesced"],
+        "storm_dropped": rb["storm_dropped"],
+    }
+
+
 def _compact_kernel(k: dict) -> dict:
     """3-6 scalars from the kernel micro-bench; the full block (roofline
     models, propose timings) goes to stderr with the rest of the detail."""
@@ -563,8 +846,8 @@ def _print_final(line: dict) -> None:
     """Emit the ONE stdout line, shedding optional detail if it would
     overflow the driver's tail capture. Never raises."""
     for drop in ((), ("search_cold_runs",), ("jumbo_cold_runs",),
-                 ("kernel",), ("bucket_reuse",), ("batch_throughput",),
-                 ("scenarios", "rows_schema")):
+                 ("kernel",), ("bucket_reuse",), ("replay_day",),
+                 ("batch_throughput",), ("scenarios", "rows_schema")):
         for key in drop:
             line.pop(key, None)
         s = json.dumps(line)
@@ -581,7 +864,8 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          jumbo_runs: list[float] | None = None,
          search_cold_runs: dict | None = None,
          bucket_reuse: dict | None = None,
-         batch_throughput: dict | None = None) -> None:
+         batch_throughput: dict | None = None,
+         replay_day: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
         line = {
@@ -658,6 +942,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # batched-lane throughput: solves/s at B in {1,2,4,8} same-bucket
         # instances + B=8-vs-sequential speedup + per-lane quality flags
         line["batch_throughput"] = batch_throughput
+    if replay_day:
+        # event-day replay: warm delta solves vs cold re-solves over
+        # one scripted day — p50/p99 latency split, per-event quality,
+        # storm coalescing with zero drops (docs/WATCH.md)
+        line["replay_day"] = replay_day
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)
@@ -685,10 +974,40 @@ def main() -> int:
                     help="also run the batched-lane throughput scenario "
                          "(B in {1,2,4,8} same-bucket instances; "
                          "auto-enabled with --all)")
+    ap.add_argument("--replay-day", action="store_true",
+                    help="run ONLY the event-day replay harness "
+                         "(docs/WATCH.md): a scripted day of cluster "
+                         "events — rolling decommission, partition "
+                         "growth, rack loss + recovery, an event "
+                         "storm — through the watch state machine on "
+                         "the warm product path, with a paired shadow "
+                         "cold solve of the identical state at every "
+                         "sequential event, reporting p50/p99 "
+                         "per-event latency, plan quality, and storm "
+                         "coalescing with zero drops")
     args = ap.parse_args()
 
     if args.child:
         return child_main(args)
+
+    if args.replay_day:
+        # standalone replay-day mode (the soak smoke job's entry): one
+        # child, one dedicated stdout line — no scenario sweep
+        try:
+            env, platform, tpu_err = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "replay_day", "error": repr(e)[:300]}))
+            return 0
+        rb, eb = _run_child(args, "replay_day", env, warmrun=False,
+                            replay_day=True)
+        if rb is not None:
+            print("[bench] REPLAY " + json.dumps(rb), file=sys.stderr)
+        line = {"metric": "replay_day", "platform": platform,
+                **_compact_replay(rb, eb)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
 
     try:
         env, platform, tpu_err = resolve_backend()
@@ -797,6 +1116,18 @@ def main() -> int:
             search_cold_runs[sname] = runs
         search_cold_runs = search_cold_runs or None
 
+    replay_day: dict | None = None
+    if args.all:
+        # the event-day replay (ISSUE 7 tentpole evidence): warm delta
+        # solves vs cold re-solves over the same scripted day of
+        # cluster events, compacted to the latency/quality/coalescing
+        # verdict for stdout
+        rr, er = _run_child(args, "replay_day", env, warmrun=False,
+                            replay_day=True)
+        if rr is not None:
+            print("[bench] REPLAY " + json.dumps(rr), file=sys.stderr)
+        replay_day = _compact_replay(rr, er)
+
     batch_throughput: dict | None = None
     if args.all or args.batch_bench:
         # the batched-lane throughput scenario (PR-2 tentpole evidence):
@@ -818,7 +1149,8 @@ def main() -> int:
     emit(head, platform, tpu_err, args.scenario, head_err,
          scenarios=rows if args.all else None, cold_cached=cold_cached,
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
-         bucket_reuse=bucket_reuse, batch_throughput=batch_throughput)
+         bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
+         replay_day=replay_day)
     return 0
 
 

@@ -14,10 +14,16 @@ Layer map (mirrors SURVEY.md §1):
   branch-and-bound, and the flagship JAX/TPU annealing engine
 - ``ops``     — scoring ops (XLA + Pallas TPU kernels)
 - ``parallel``— device mesh, shard_map solve, ICI collectives
+- ``watch``   — cluster-watch delta mode: events, plan store, fencing
 - ``utils``   — reporting, RNG, checkpointing
 """
 
-from .api import evaluate, optimize, OptimizeResult  # noqa: F401
+from .api import (  # noqa: F401
+    evaluate,
+    optimize,
+    optimize_delta,
+    OptimizeResult,
+)
 from .models.cluster import (  # noqa: F401
     Assignment,
     MoveReport,

@@ -108,6 +108,61 @@ def optimize(
     )
 
 
+def optimize_delta(
+    current: Assignment | str | dict,
+    broker_list: Sequence[int],
+    topology: Topology | dict | None = None,
+    target_rf: int | dict | None = None,
+    prev_plan: Assignment | str | dict | None = None,
+    solver: str = "auto",
+    instance: ProblemInstance | None = None,
+    **solver_kwargs,
+) -> OptimizeResult:
+    """One step of the cluster-watch delta path (docs/WATCH.md):
+    :func:`optimize`, warm-started from ``prev_plan`` — the previous
+    certified plan adapted to the post-event topology (dead brokers and
+    racks evicted, surviving replicas kept in place,
+    ``watch.adapt.adapt_plan``). Adaptation that produces no usable
+    candidate takes the ``warm_start_rejected`` degradation rung and
+    the solve runs cold; solvers without a warm-start path (the exact
+    MILP/LP backends certify from scratch anyway) simply ignore it.
+    """
+    if isinstance(current, str):
+        current = Assignment.from_json(current)
+    elif isinstance(current, dict):
+        current = Assignment.from_dict(current)
+    if isinstance(topology, dict):
+        topology = Topology.from_dict(topology)
+    if isinstance(prev_plan, str):
+        prev_plan = Assignment.from_json(prev_plan)
+    elif isinstance(prev_plan, dict):
+        prev_plan = Assignment.from_dict(prev_plan)
+
+    inst = (
+        instance if instance is not None
+        else build_instance(current, broker_list, topology, target_rf)
+    )
+    from .solvers.base import resolve_solver
+
+    solver_eff = resolve_solver(solver, inst)
+    if prev_plan is not None and solver_eff == "tpu":
+        from .resilience import ladder as _ladder
+        from .watch.adapt import adapt_plan
+
+        warm_a, reason = adapt_plan(inst, prev_plan)
+        if warm_a is None:
+            # rejection is a LADDER step, not a silent downgrade: the
+            # rung lands on the counter/trace/stats like every other
+            # (the engine's own validator covers the in-engine cases)
+            _ladder.note_rung("warm_start_rejected", reason=reason[:200])
+        else:
+            solver_kwargs.setdefault("warm_start", warm_a)
+    return optimize(
+        current, broker_list, topology, target_rf=target_rf,
+        solver=solver_eff, instance=inst, **solver_kwargs,
+    )
+
+
 def optimize_batch(
     currents: Sequence[Assignment],
     instances: Sequence[ProblemInstance],

@@ -33,8 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--output", "-o", help="write plan JSON here (default: stdout)")
     ap.add_argument(
         "--broker-list",
-        required=True,
-        help="target brokers, e.g. '0,1,2' or '0-18' (README.md:48)",
+        help="target brokers, e.g. '0,1,2' or '0-18' (README.md:48); "
+        "required except with --events (the event stream carries its "
+        "own broker lists)",
     )
     ap.add_argument(
         "--topology",
@@ -124,6 +125,29 @@ def build_parser() -> argparse.ArgumentParser:
         "plan, with every degradation rung in the --report stats",
     )
     ap.add_argument(
+        "--events",
+        metavar="FILE",
+        help="cluster-watch replay (docs/WATCH.md): apply a JSON file "
+        "of epoch-fenced change events — a list, or {'cluster_id', "
+        "'events': [...]} — through the same fencing/warm-start "
+        "machinery the serve delta API runs. The first event of an "
+        "unknown cluster must be a 'bootstrap'. Prints the final plan "
+        "to stdout and a per-event report line to stderr; --input / "
+        "--broker-list are not used",
+    )
+    ap.add_argument(
+        "--cluster-id",
+        default="default",
+        help="cluster name for --events (default: 'default')",
+    )
+    ap.add_argument(
+        "--watch-dir",
+        metavar="DIR",
+        help="durable plan store for --events: state + last certified "
+        "plan per cluster persist here (atomic, fingerprint-verified), "
+        "so a later replay resumes at the stored epoch",
+    )
+    ap.add_argument(
         "--distributed",
         action="store_true",
         help="initialize jax's multi-host runtime before solving. Run "
@@ -208,6 +232,10 @@ def _run(args: argparse.Namespace) -> int:
         from .parallel.distributed import init_distributed
 
         init_distributed()
+    if args.events:
+        return _run_events(args)
+    if not args.broker_list:
+        raise ValueError("--broker-list is required (unless --events)")
     text = Path(args.input).read_text() if args.input else sys.stdin.read()
     current = Assignment.from_json(text)
     target_rf = parse_rf(args.rf)
@@ -280,6 +308,85 @@ def _run(args: argparse.Namespace) -> int:
         # kao: disable=KAO106 -- --report's stderr JSON is the CLI's UX contract
         print(json.dumps(rep, indent=2, default=str), file=sys.stderr)
     return 0 if rep["feasible"] else 3
+
+
+def _run_events(args: argparse.Namespace) -> int:
+    """``--events``: offline replay of a cluster-change stream through
+    the watch state machine (docs/WATCH.md) — fencing, durable store,
+    and warm-started delta solves identical to the serve delta API,
+    minus the HTTP."""
+    from .api import optimize_delta
+    from .watch.manager import FencedEpoch, WatchRegistry
+    from .watch.store import PlanStore
+
+    doc = json.loads(Path(args.events).read_text())
+    if isinstance(doc, dict):
+        cluster_id = doc.get("cluster_id", args.cluster_id)
+        events = doc.get("events")
+    else:
+        cluster_id, events = args.cluster_id, doc
+    if not isinstance(events, list) or not events:
+        raise ValueError(
+            "--events file must be a non-empty list of events or "
+            "{'cluster_id', 'events': [...]}"
+        )
+
+    kw: dict = {"seed": args.seed or 0}
+    if args.batch:
+        kw["batch"] = args.batch
+    if args.sweeps:
+        kw["sweeps"] = args.sweeps
+    if args.engine:
+        kw["engine"] = args.engine
+    if args.time_limit:
+        kw["time_limit_s"] = args.time_limit
+    if args.no_pipeline:
+        kw["pipeline"] = False
+
+    def solve_fn(state, prev_plan, budget):
+        res = optimize_delta(
+            state.assignment, state.brokers, state.topology,
+            target_rf=state.rf, prev_plan=prev_plan,
+            solver=args.solver, **kw,
+        )
+        return res.assignment.to_dict(), res.report()
+
+    store = PlanStore(args.watch_dir) if args.watch_dir else None
+    reg = WatchRegistry(solve_fn, store, window_s=0.0)
+    last_plan = None
+    rc = 0
+    for i, ev in enumerate(events):
+        try:
+            out = reg.handle_event(cluster_id, ev)
+        except FencedEpoch as e:
+            # kao: disable=KAO106 -- per-event stderr lines are the replay's UX contract
+            print(f"event[{i}] FENCED: {e}", file=sys.stderr)
+            rc = 3
+            continue
+        rep = out.get("report") or {}
+        # kao: disable=KAO106 -- per-event stderr lines are the replay's UX contract
+        print(
+            f"event[{i}] type={ev.get('type')} epoch={out['epoch']} "
+            f"status={out['status']} "
+            f"moves={rep.get('replica_moves')} "
+            f"feasible={rep.get('feasible')} "
+            f"warm={bool(rep.get('solver_warm_started'))}",
+            file=sys.stderr,
+        )
+        if out.get("assignment") is not None:
+            last_plan = out["assignment"]
+        if rep and not rep.get("feasible", True):
+            rc = 3
+    if last_plan is None:
+        info = reg.get_cluster(cluster_id) or {}
+        last_plan = info.get("plan")
+    out_text = json.dumps(last_plan, indent=args.indent)
+    if args.output:
+        Path(args.output).write_text(out_text + "\n")
+    else:
+        # kao: disable=KAO106 -- the final plan JSON on stdout IS the product
+        print(out_text)
+    return rc
 
 
 if __name__ == "__main__":

@@ -83,6 +83,25 @@ def request_schema() -> dict:
                 "response": "per-shape bucket, wall clock, and compile "
                             "counters; already_warm when cached",
             },
+            "POST /clusters/<id>/events": {
+                "request": "ONE typed, epoch-fenced cluster change "
+                           "(docs/WATCH.md): {'type': 'bootstrap' | "
+                           "'broker_add' | 'broker_remove' | "
+                           "'broker_drain' | 'rack_fail' | "
+                           "'partition_growth' | 'rf_change', "
+                           "'epoch': int, ...type fields}; bootstrap "
+                           "carries assignment/brokers/topology/rf",
+                "response": "200: the new certified plan, warm-started "
+                            "from the cluster's previous plan; 202: "
+                            "event coalesced behind an in-flight solve "
+                            "(fetch GET /clusters/<id>); 409: stale or "
+                            "replayed epoch (no solve runs); 503 "
+                            "reason=event_storm: backpressure with "
+                            "Retry-After",
+            },
+            "GET /clusters": "watched clusters + delta-API counters; "
+                             "/clusters/<id> returns one cluster's "
+                             "state, epoch, and last certified plan",
             "GET /healthz": "service status, available solvers, "
                             "platform, executable-cache + queue state",
             "GET /metrics": "Prometheus text counters (kao_*, incl. "
@@ -139,6 +158,7 @@ global-optimality certificate when the plan meets its LP/flow bounds.</p>
   <a href="/healthz">/healthz</a>
   <a href="/metrics">/metrics</a>
   <a href="/schema">/schema</a>
+  <a href="/clusters">/clusters</a>
 </nav>
 
 <h2>API</h2>
@@ -149,7 +169,12 @@ global-optimality certificate when the plan meets its LP/flow bounds.</p>
 <p>Full request/response shapes: <a href="/schema">GET /schema</a>.
 Audit an existing plan (yours or
 <code>kafka-reassign-partitions</code> output) with
-<code>POST /evaluate</code> — same fields plus <code>"plan"</code>.</p>
+<code>POST /evaluate</code> — same fields plus <code>"plan"</code>.
+For clusters that change over time, the delta API
+(<code>POST /clusters/&lt;id&gt;/events</code>) remembers each named
+cluster's last certified plan and re-solves incrementally per
+epoch-fenced change event — broker add/remove/drain, rack failure,
+partition growth, RF change (docs/WATCH.md).</p>
 
 <h2>Extended example (live)</h2>
 <p>Prefilled with the worked demo: a 20-broker cluster spread over two

@@ -230,7 +230,13 @@ def move_diff(old: Assignment, new: Assignment) -> MoveReport:
         new_set = set(news.replicas) if news else set()
         add = sorted(new_set - old_set)
         rem = sorted(old_set - new_set)
-        lead_changed = bool(olds and news and olds.leader != news.leader)
+        # a partition with an empty replica list (declared but not yet
+        # placed — the delta API's partition_growth) has no leader to
+        # change: its initial placement is charged as replica moves
+        lead_changed = bool(
+            olds and news and olds.replicas and news.replicas
+            and olds.replicas[0] != news.replicas[0]
+        )
         if add or rem or lead_changed:
             changed.append(key)
         if add:

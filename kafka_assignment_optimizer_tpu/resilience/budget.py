@@ -47,13 +47,27 @@ class Budget:
 
     ``Budget(None)`` is the unlimited budget: ``remaining()`` is None,
     ``expired()`` is False, ``cap()`` passes timeouts through — so call
-    sites need no ``if time_limit_s is None`` forests."""
+    sites need no ``if time_limit_s is None`` forests.
 
-    __slots__ = ("t0", "limit_s")
+    :meth:`cancel` collapses the remaining budget to zero from another
+    thread: every deadline gate that already asks ``remaining()`` then
+    stops at its next check. This is how a superseded solve is reclaimed
+    (watch-mode event storms, docs/WATCH.md) — the engine's existing
+    ``deadline_truncated`` rung retires it with its best-so-far plan, no
+    new cancellation protocol required."""
+
+    __slots__ = ("t0", "limit_s", "cancelled")
 
     def __init__(self, limit_s: float | None, t0: float | None = None):
         self.limit_s = None if limit_s is None else float(limit_s)
         self.t0 = time.perf_counter() if t0 is None else float(t0)
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        """Collapse the budget: ``remaining()`` is 0.0 and ``expired()``
+        is True from now on, even on an unlimited budget. Thread-safe by
+        virtue of being a monotonic one-way flag."""
+        self.cancelled = True
 
     @property
     def deadline(self) -> float | None:
@@ -63,7 +77,10 @@ class Budget:
         return self.t0 + self.limit_s
 
     def remaining(self) -> float | None:
-        """Seconds left (clamped at 0.0); None = unlimited."""
+        """Seconds left (clamped at 0.0); None = unlimited. A cancelled
+        budget always reports 0.0 — unlimited included."""
+        if self.cancelled:
+            return 0.0
         if self.limit_s is None:
             return None
         return max(0.0, self.t0 + self.limit_s - time.perf_counter())

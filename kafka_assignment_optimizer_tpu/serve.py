@@ -61,6 +61,22 @@ Endpoints:
     same bucket (``solvers.tpu.bucket``) runs fully warm. Also runs at
     startup via ``--warmup B:P[:R[:K]],...``.
 
+``POST /clusters/<id>/events``
+    The cluster-watch delta API (docs/WATCH.md): one typed, epoch-
+    fenced state diff — ``bootstrap``, ``broker_add``,
+    ``broker_remove``, ``broker_drain``, ``rack_fail``,
+    ``partition_growth``, ``rf_change`` — against a named cluster whose
+    last certified plan and topology the service remembers (durably,
+    with ``--watch-dir``). 200 returns the new plan (warm-started from
+    the previous one); 202 acknowledges an event coalesced behind an
+    in-flight solve; 409 rejects a stale/replayed epoch (structured,
+    provably without a solve); 503 ``event_storm`` is backpressure
+    with a Retry-After from the coalescing window.
+
+``GET /clusters`` / ``GET /clusters/<id>``
+    Watched-cluster listing / one cluster's state, epoch, and last
+    certified plan.
+
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu",
     "cache": {...bucket/executable counters...}, "queue": {...}}``
@@ -118,6 +134,9 @@ from .resilience import breaker as _breaker
 from .resilience import budget as _rbudget
 from .resilience import chaos as _chaos
 from .resilience import ladder as _ladder
+from .watch import events as _wevents
+from .watch import manager as _wmanager
+from .watch import store as _wstore
 
 # audits (/evaluate) hold their OWN lock (VERDICT r4 item 8): they are
 # pure host-side work (numpy + bound LPs + the native flow kernel — no
@@ -164,6 +183,26 @@ DEFAULT_QUEUE_WAIT_S = 15.0
 RESILIENCE = {
     "default_deadline_s": None,
     "checkpoint_dir": None,
+    # --checkpoint-dir hygiene (ISSUE 7 satellite): the periodic
+    # maintenance pass GCs fingerprint-keyed .npz checkpoints past
+    # these caps (age first, then oldest beyond the count cap); the
+    # live file count is exported as the kao_checkpoint_files gauge
+    "checkpoint_max_files": 512,
+    "checkpoint_max_age_s": 7 * 24 * 3600.0,
+}
+
+# cluster-watch delta API (docs/WATCH.md): POST /clusters/<id>/events.
+# "dir" is the OPERATOR-chosen durable plan-store directory
+# (--watch-dir); without it the watch endpoints still work but state is
+# process-local only (healthz says durable: false). The registry is
+# built lazily so tests can point "dir" somewhere and reset.
+WATCH = {
+    "dir": None,
+    "window_s": _wmanager.DEFAULT_WINDOW_S,
+    "max_backlog": _wmanager.DEFAULT_MAX_BACKLOG,
+    "registry": None,
+    "lock_wait_s": DEFAULT_LOCK_WAIT_S,
+    "max_solve_s": DEFAULT_MAX_SOLVE_S,
 }
 
 # circuit breaker on repeated solver failures per bucket key
@@ -387,6 +426,12 @@ class _SolveQueue:
             with self._cv:
                 self._draining = False
                 self._cv.notify_all()
+        # checkpoint-dir hygiene rides the same maintenance cadence
+        # (ISSUE 7 satellite): age + count caps, never fatal. Runs even
+        # when the cache clear was skipped — file GC needs no exclusion
+        # (utils.checkpoint.load treats a vanished file as no
+        # checkpoint, and writes are atomic-rename).
+        _gc_checkpoints()
 
     def stats(self) -> dict:
         with self._lock:
@@ -447,6 +492,65 @@ class _SolveQueue:
         return min(max(last * backlog, 1.0), 60.0)
 
 
+def _checkpoint_files() -> list:
+    """The ``.npz`` checkpoints currently under --checkpoint-dir (empty
+    when the feature is off or the dir vanished)."""
+    d = RESILIENCE["checkpoint_dir"]
+    if not d:
+        return []
+    import glob
+    import os
+
+    return glob.glob(os.path.join(d, "*.npz"))
+
+
+def _gc_checkpoints() -> int:
+    """--checkpoint-dir hygiene (ISSUE 7 satellite): fingerprint-keyed
+    checkpoints accumulate one file per distinct cluster forever. Drop
+    files older than ``checkpoint_max_age_s``, then the oldest beyond
+    ``checkpoint_max_files``. Returns how many were removed; never
+    raises (a GC failure must not take down maintenance)."""
+    import os
+    import time as _time
+
+    removed = 0
+    try:
+        files = _checkpoint_files()
+        if not files:
+            return 0
+        now = _time.time()
+        max_age = RESILIENCE["checkpoint_max_age_s"]
+        max_files = RESILIENCE["checkpoint_max_files"]
+        aged = []
+        for f in files:
+            try:
+                mtime = os.path.getmtime(f)
+            except OSError:
+                continue  # raced with another GC / a fresh write
+            if max_age is not None and now - mtime > max_age:
+                try:
+                    os.remove(f)
+                    removed += 1
+                except OSError:
+                    pass
+            else:
+                aged.append((mtime, f))
+        if max_files is not None and len(aged) > max_files:
+            aged.sort()  # oldest first
+            for _, f in aged[: len(aged) - int(max_files)]:
+                try:
+                    os.remove(f)
+                    removed += 1
+                except OSError:
+                    pass
+        if removed:
+            _olog.log("checkpoint_gc", removed=removed,
+                      remaining=len(files) - removed)
+    except Exception:
+        pass
+    return removed
+
+
 _SOLVES = _SolveQueue()
 
 # service counters (GET /metrics, Prometheus text format); guarded by
@@ -476,7 +580,7 @@ _BATCH_SIZES: dict[int, int] = {}
 # pre-declared so /metrics always exposes the family at zero
 _SHED_REASON_NAMES = (
     "queue_full", "service_window", "coalesce_window", "audit_busy",
-    "circuit_open", "deadline",
+    "circuit_open", "deadline", "event_storm",
 )
 _SHED_REASONS: dict[str, int] = {}
 
@@ -582,6 +686,27 @@ def render_metrics() -> str:
     # unless KAO_SANITIZE / --sanitize armed the guards
     for k, v in _sanitize_mod.snapshot().items():
         snap[f"sanitizer_{k}"] = v
+    # --checkpoint-dir hygiene gauge (ISSUE 7 satellite): live .npz
+    # count under the operator's checkpoint dir; the maintenance GC
+    # (age + count caps) is what keeps this bounded
+    snap["checkpoint_files"] = len(_checkpoint_files())
+    # cluster-watch delta API counters (docs/WATCH.md): pre-declared at
+    # zero so dashboards see the families before the first event; the
+    # live registry overlays its actual counts
+    watch_zeroes = {
+        "events_total": 0, "fenced_total": 0, "coalesced_total": 0,
+        "superseded_total": 0, "storm_sheds_total": 0,
+        "solves_total": 0, "warm_solves_total": 0,
+        "solve_errors_total": 0, "clusters": 0,
+    }
+    reg = WATCH.get("registry")
+    if reg is not None:
+        watch_zeroes.update({
+            k: v for k, v in reg.snapshot().items()
+            if isinstance(v, (int, float)) and k in watch_zeroes
+        })
+    for k, v in watch_zeroes.items():
+        snap[f"watch_{k}"] = v
     # resilience gauges (docs/RESILIENCE.md): circuit-breaker state and
     # whether a chaos spec is armed (a production scrape showing
     # kao_chaos_armed 1 is itself an alert)
@@ -1295,6 +1420,185 @@ def handle_evaluate(payload: dict, lock_wait_s: float,
     return out
 
 
+def _watch_solve_fn(state, prev_plan, budget) -> tuple[dict, dict]:
+    """The registry-injected delta solver (docs/WATCH.md): build the
+    post-event instance, warm-start from the previous certified plan
+    (``api.optimize_delta``), and run it through the SAME serving
+    machinery a /submit solve uses — the bounded worker queue, the
+    per-bucket circuit breaker, and the solve-trace ring. The caller's
+    ``budget`` threads into the engine, so a superseding event
+    cancelling it retires this solve at the next chunk boundary."""
+    from .api import optimize_delta
+    from .models.instance import build_instance
+    from .solvers.base import resolve_solver
+
+    inst = build_instance(state.assignment, state.brokers,
+                          state.topology, state.rf)
+    solver_eff = resolve_solver("auto", inst)
+    bucket_key: tuple
+    if solver_eff == "tpu":
+        from .solvers.tpu import bucket
+
+        bucket_key = (inst.num_brokers, inst.num_racks,
+                      *bucket.bucket_shape(inst))
+    else:
+        bucket_key = ("solver", solver_eff)
+    trace_id = _otrace.new_trace_id() if OBS["trace"] else None
+    max_solve_s = WATCH["max_solve_s"]
+
+    def job():
+        t0 = time.perf_counter()
+        kw: dict = {}
+        if solver_eff == "tpu":
+            kw["budget"] = budget
+            if max_solve_s is not None:
+                kw["time_limit_s"] = max_solve_s
+            prof = _profile_dir_for(bucket_key, trace_id)
+            if prof:
+                kw["profile_dir"] = prof
+        tr = _otrace.begin(trace_id, name="watch_event",
+                           cluster=state.cluster_id, epoch=state.epoch)
+        try:
+            res = optimize_delta(
+                state.assignment, state.brokers, state.topology,
+                target_rf=state.rf, prev_plan=prev_plan,
+                solver=solver_eff, instance=inst, **kw,
+            )
+        except BaseException as e:
+            if tr is not None:
+                tr.root.set(error=repr(e)[:200])
+                _otrace.finish(tr)
+            _olog.error("watch_solve_failed", trace_id=trace_id,
+                        cluster=state.cluster_id, epoch=state.epoch,
+                        error=repr(e)[:200])
+            raise
+        dt = time.perf_counter() - t0
+        with _METRICS_LOCK:
+            _METRICS["solves_total"] += 1
+            _METRICS["solve_seconds_total"] += dt
+            _METRICS["last_solve_seconds"] = dt
+        rep = res.report()
+        if tr is not None:
+            tr.root.set(solver=res.solve.solver,
+                        feasible=rep.get("feasible"),
+                        replica_moves=rep.get("replica_moves"),
+                        warm_started=bool(
+                            rep.get("solver_warm_started")
+                        ),
+                        wall_s=round(dt, 4))
+            _otrace.finish(tr)
+        _olog.log("watch_solve", trace_id=trace_id,
+                  cluster=state.cluster_id, epoch=state.epoch,
+                  solver=res.solve.solver, wall_s=round(dt, 4),
+                  feasible=rep.get("feasible"),
+                  moves=rep.get("replica_moves"),
+                  warm=bool(rep.get("solver_warm_started")))
+        if trace_id:
+            rep["trace_id"] = trace_id
+        return res.assignment.to_dict(), rep
+
+    return _breaker_guarded(
+        bucket_key,
+        lambda: _SOLVES.submit(job, wait_s=WATCH["lock_wait_s"],
+                               budget_s=max_solve_s),
+    )
+
+
+def _watch_registry() -> _wmanager.WatchRegistry:
+    """The process's one watch registry, built lazily from WATCH (so
+    main() and tests configure before first touch)."""
+    reg = WATCH.get("registry")
+    if reg is None:
+        store = (
+            _wstore.PlanStore(WATCH["dir"]) if WATCH["dir"] else None
+        )
+        reg = _wmanager.WatchRegistry(
+            _watch_solve_fn, store,
+            window_s=WATCH["window_s"],
+            max_backlog=WATCH["max_backlog"],
+            solve_budget_s=WATCH["max_solve_s"],
+        )
+        WATCH["registry"] = reg
+    return reg
+
+
+def handle_cluster_event(
+    cluster_id: str,
+    payload: dict,
+    *,
+    lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+    max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+) -> tuple[int, dict]:
+    """POST /clusters/<id>/events — one fenced, typed state diff
+    (docs/WATCH.md). Returns ``(http_status, body)``: 200 with the new
+    certified plan when this request ran the solve, 202 when the event
+    was coalesced behind an in-flight solve. Raises ApiError for
+    malformed events (400), stale/replayed epochs (409, provably
+    without a solve), impossible states (422), and storm backpressure
+    (503 ``event_storm`` with Retry-After from the coalescing window)."""
+    WATCH["lock_wait_s"] = lock_wait_s
+    WATCH["max_solve_s"] = max_solve_s
+    reg = _watch_registry()
+    try:
+        out = reg.handle_event(cluster_id, payload)
+    except _wmanager.FencedEpoch as e:
+        # the fencing contract: structured 409, idempotent (nothing was
+        # applied), and PROVABLY no solve — kao_watch_fenced_total moves,
+        # kao_solves_total does not, and no trace is born
+        raise ApiError(
+            409,
+            str(e),
+            body={
+                "reason": "stale_epoch",
+                "cluster_id": e.cluster_id,
+                "epoch": e.got,
+                "current_epoch": e.current,
+                "expected_min_epoch": e.current + 1,
+                "plan_epoch": e.plan_epoch,
+            },
+        ) from e
+    except _wmanager.StormShed as e:
+        raise _shed(
+            "event_storm",
+            str(e),
+            retry_after_s=e.retry_after_s,
+            cluster_id=e.cluster_id,
+            backlog=e.backlog,
+        ) from e
+    except _wevents.EventError as e:
+        raise ApiError(400, str(e)) from e
+    except ApiError:
+        raise
+    except (ValueError, KeyError) as e:
+        msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
+        raise ApiError(422, f"model rejected the post-event state: "
+                            f"{msg}") from e
+    except RuntimeError as e:
+        raise ApiError(500, f"delta solve failed: {e}") from e
+    status = 202 if out.get("status") == "accepted" else 200
+    return status, out
+
+
+def handle_clusters_get(cluster_id: str | None = None) -> dict:
+    """GET /clusters (listing) and GET /clusters/<id> (state + last
+    certified plan)."""
+    reg = _watch_registry()
+    if cluster_id is None:
+        return {"clusters": reg.list_clusters(),
+                "watch": reg.snapshot()}
+    try:
+        info = reg.get_cluster(cluster_id)
+    except _wevents.EventError as e:
+        raise ApiError(400, str(e)) from e
+    if info is None:
+        raise ApiError(
+            404,
+            f"unknown cluster {cluster_id!r}; bootstrap it with "
+            "POST /clusters/<id>/events",
+        )
+    return info
+
+
 def handle_healthz() -> dict:
     import jax
 
@@ -1332,9 +1636,24 @@ def handle_healthz() -> dict:
             "degradations": _ladder.snapshot(),
             "default_deadline_s": RESILIENCE["default_deadline_s"],
             "checkpoint_dir": RESILIENCE["checkpoint_dir"],
+            "checkpoint_files": len(_checkpoint_files()),
+            "checkpoint_max_files": RESILIENCE["checkpoint_max_files"],
+            "checkpoint_max_age_s": RESILIENCE["checkpoint_max_age_s"],
             "queue_wait_s": _SOLVES.queue_wait_s,
         },
+        "watch": _healthz_watch(),
     }
+
+
+def _healthz_watch() -> dict:
+    """The /healthz watch section. The registry is built lazily and its
+    PlanStore touches the filesystem — a probe endpoint must degrade to
+    an error field, never die with a traceback, if the watch dir went
+    bad after boot (startup validates it; permissions can change)."""
+    try:
+        return {"dir": WATCH["dir"], **_watch_registry().snapshot()}
+    except Exception as e:  # pragma: no cover - post-boot dir breakage
+        return {"dir": WATCH["dir"], "error": repr(e)[:200]}
 
 
 def _synthetic_cluster(brokers: int, partitions: int, rf: int,
@@ -1580,6 +1899,17 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif route == "/clusters":
+            self._send(200, handle_clusters_get())
+        elif route.startswith("/clusters/"):
+            try:
+                self._send(200, handle_clusters_get(
+                    route[len("/clusters/"):]
+                ))
+            except ApiError as e:
+                if e.status != 503:
+                    _count(errors_total=1)
+                self._send(e.status, {"error": str(e), **e.body_extra})
         elif route == "/debug/solves":
             # most-recent-first listing of retrievable solve reports
             self._send(200, {"trace_ids": _otrace.RECENT.ids()})
@@ -1600,7 +1930,11 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         route = self._route()
-        if route not in ("/submit", "/evaluate", "/warmup"):
+        cluster_id = None
+        if route.startswith("/clusters/") and route.endswith("/events"):
+            cluster_id = route[len("/clusters/"):-len("/events")]
+        if route not in ("/submit", "/evaluate", "/warmup") \
+                and cluster_id is None:
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
@@ -1638,6 +1972,13 @@ class Handler(BaseHTTPRequestHandler):
                     payload, lock_wait_s=lock_wait_s,
                     max_solve_s=max_solve_s,
                 ))
+                return
+            if cluster_id is not None:
+                status, body = handle_cluster_event(
+                    cluster_id, payload, lock_wait_s=lock_wait_s,
+                    max_solve_s=max_solve_s,
+                )
+                self._send(status, body)
                 return
             self._send(200, handle_submit(
                 payload, lock_wait_s=lock_wait_s, max_solve_s=max_solve_s,
@@ -1752,6 +2093,39 @@ def main(argv: list[str] | None = None) -> int:
                          "worker-crash retry or a repeated solve of "
                          "the same cluster warm-starts from the last "
                          "completed plan")
+    ap.add_argument("--checkpoint-max-files", type=int, default=512,
+                    metavar="N",
+                    help="checkpoint-dir hygiene: keep at most this "
+                         "many fingerprint-keyed .npz checkpoints "
+                         "(oldest GC'd on the maintenance pass; live "
+                         "count on /metrics as kao_checkpoint_files)")
+    ap.add_argument("--checkpoint-max-age-s", type=float,
+                    default=7 * 24 * 3600.0, metavar="S",
+                    help="checkpoint-dir hygiene: GC checkpoints older "
+                         "than this on the maintenance pass")
+    ap.add_argument("--watch-dir", default=None, metavar="DIR",
+                    help="durable per-cluster plan store for the "
+                         "cluster-watch delta API (docs/WATCH.md): "
+                         "POST /clusters/<id>/events remembers each "
+                         "cluster's last certified plan + epoch here, "
+                         "atomically, surviving kill -9 + restart. "
+                         "Without it the delta API still works but "
+                         "state is process-local")
+    ap.add_argument("--watch-window-ms", type=float,
+                    default=_wmanager.DEFAULT_WINDOW_S * 1e3,
+                    metavar="MS",
+                    help="event-storm coalescing window: after a "
+                         "superseded solve, the re-solve of the latest "
+                         "cluster state waits this long for the burst "
+                         "to settle (one re-solve per burst, not per "
+                         "event)")
+    ap.add_argument("--watch-max-backlog", type=int,
+                    default=_wmanager.DEFAULT_MAX_BACKLOG, metavar="N",
+                    help="event-storm backpressure: events piling up "
+                         "behind one in-flight solve past this count "
+                         "shed with 503 reason=event_storm and a "
+                         "Retry-After derived from the coalescing "
+                         "window; admitted events are never dropped")
     ap.add_argument("--breaker-threshold", type=int, default=3,
                     metavar="N",
                     help="consecutive solver failures on one bucket "
@@ -1828,11 +2202,38 @@ def main(argv: list[str] | None = None) -> int:
     _COALESCER.configure(window_ms=args.batch_window_ms,
                          max_batch=args.max_batch)
     RESILIENCE["default_deadline_s"] = args.default_deadline_s
+    if args.checkpoint_max_files < 1:
+        ap.error("--checkpoint-max-files must be >= 1")
+    if args.checkpoint_max_age_s <= 0:
+        ap.error("--checkpoint-max-age-s must be > 0")
+    if args.watch_window_ms < 0:
+        ap.error("--watch-window-ms must be >= 0")
+    if args.watch_max_backlog < 1:
+        ap.error("--watch-max-backlog must be >= 1")
+    RESILIENCE["checkpoint_max_files"] = args.checkpoint_max_files
+    RESILIENCE["checkpoint_max_age_s"] = args.checkpoint_max_age_s
     if args.checkpoint_dir:
         import os
 
         os.makedirs(args.checkpoint_dir, exist_ok=True)
         RESILIENCE["checkpoint_dir"] = args.checkpoint_dir
+    if args.watch_dir:
+        # fail fast at boot like --checkpoint-dir: the registry is
+        # built lazily on first touch, and /healthz is one of those
+        # touches — an unwritable plan-store dir must be a clean
+        # startup error, never a liveness-probe traceback
+        import os
+
+        try:
+            os.makedirs(args.watch_dir, exist_ok=True)
+        except OSError as e:
+            ap.error(f"--watch-dir {args.watch_dir!r}: {e}")
+    WATCH["dir"] = args.watch_dir
+    WATCH["window_s"] = args.watch_window_ms / 1e3
+    WATCH["max_backlog"] = args.watch_max_backlog
+    WATCH["lock_wait_s"] = args.lock_wait_s
+    WATCH["max_solve_s"] = args.max_solve_s or None
+    WATCH["registry"] = None  # rebuilt lazily with this config
     _BREAKER.configure(threshold=args.breaker_threshold,
                        cooldown_s=args.breaker_cooldown_s)
     if args.chaos:

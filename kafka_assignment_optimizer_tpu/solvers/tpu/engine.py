@@ -270,6 +270,8 @@ def _solve_tpu(
     cert_min_savings_s: float = 1.0,
     precompile: bool = False,
     pipeline: bool | None = None,
+    warm_start: "np.ndarray | None" = None,
+    budget: Budget | None = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
@@ -277,8 +279,17 @@ def _solve_tpu(
     # join, retry and wall-clock gate below asks it for remaining time
     # instead of re-deriving t0 + time_limit_s arithmetic — which is
     # what let a timed-out sweep grant its chain retry the full budget
-    # again (satellite fix, ISSUE 6)
-    budget = Budget(time_limit_s, t0=t0)
+    # again (satellite fix, ISSUE 6). A CALLER-owned budget (the watch
+    # delta path, docs/WATCH.md) is honored instead of a fresh one: its
+    # clock already includes queue wait, and cancel()ing it from another
+    # thread retires this solve at the next boundary gate.
+    if budget is None:
+        budget = Budget(time_limit_s, t0=t0)
+    # delta-API warm start (docs/WATCH.md): a previous plan adapted to
+    # this instance's topology seeds the annealer. Structurally invalid
+    # candidates are REJECTED onto the ladder (warm_start_rejected) and
+    # the solve proceeds from scratch — never silently trusted.
+    warm_start = _validate_warm_start(inst, warm_start)
     # double-buffered ladder dispatch (docs/PIPELINE.md): None defers
     # to the process default (--no-pipeline / KAO_NO_PIPELINE flip it)
     pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
@@ -358,21 +369,19 @@ def _solve_tpu(
     )
     members = inst._members()[0].size
     big = members > _instance_mod.AGG_MEMBER_THRESHOLD
+    worker_fn = None
+    worker_path = None
     if precompile:
         # warmup solves (serve /warmup) exist to COMPILE the device
         # path for a bucket shape; a host-side constructor certifying
         # the symmetric synthetic cluster would skip the device — and
         # the compile — entirely, so every race is disabled
-        lp_fut = None
         lp_wait_s = 0.0
     elif not multi and (_caps_bind(inst) or big or inst.agg_effective()):
         reseat_ok = _RESEAT_RACE and not knobs_set
-        lp_fut = _BoundsTask(_otrace.wrap(
-            "construct_worker",
-            lambda: _construct_worker(inst, bounds_fut,
-                                      reseat_fallback=reseat_ok),
-            path="lp",
-        ))
+        worker_fn = lambda: _construct_worker(inst, bounds_fut,
+                                              reseat_fallback=reseat_ok)
+        worker_path = "lp"
         # past the aggregation threshold the constructor (agg MILP +
         # completion + exact reseat, ~15-20 s) is far cheaper than the
         # first sweep-executable compile (minutes), so waiting longer
@@ -386,35 +395,46 @@ def _solve_tpu(
         and inst.num_parts <= _EXACT_RACE_PARTS
         and 2 * inst.num_brokers * inst.num_parts <= _EXACT_RACE_VARS
     ):
-        lp_fut = _BoundsTask(_otrace.wrap(
-            "construct_worker",
-            lambda: _exact_worker(inst, bounds_fut), path="milp",
-        ))
+        worker_fn = lambda: _exact_worker(inst, bounds_fut)
+        worker_path = "milp"
         lp_wait_s = _CONSTRUCT_WAIT_S
     elif not multi and not knobs_set and _RESEAT_RACE:
         # slack caps, no symmetry, too big for the exact MILP — the
         # adversarial class. Greedy + exact reseat races the annealer:
         # certified it skips the search entirely; uncertified it still
         # hands the ladder a better warm start than the raw greedy
-        lp_fut = _BoundsTask(_otrace.wrap(
-            "construct_worker",
-            lambda: _reseat_worker(inst, bounds_fut), path="reseat",
-        ))
+        worker_fn = lambda: _reseat_worker(inst, bounds_fut)
+        worker_path = "reseat"
         lp_wait_s = (
             _CONSTRUCT_WAIT_MID_S
             if members > _RESEAT_WAIT_MID_MEMBERS
             else _CONSTRUCT_WAIT_S
         )
     else:
-        lp_fut = None
         lp_wait_s = 0.0
+    if warm_start is not None and not multi and not precompile:
+        # delta-path warm certify (docs/WATCH.md): the adapted previous
+        # plan gets first shot at the certificate — when it holds, the
+        # solve returns it without an LP decode, a compile, or a single
+        # device dispatch. Composed IN FRONT of the class's race worker
+        # (the fall-through), not instead of it.
+        inner_fn, warm_a = worker_fn, warm_start
+        worker_fn = lambda: _warm_certify_worker(inst, bounds_fut,
+                                                 warm_a, inner_fn)
+        worker_path = f"warm+{worker_path}" if worker_path else "warm"
+        lp_wait_s = max(lp_wait_s, _CONSTRUCT_WAIT_S)
+    lp_fut = (
+        _BoundsTask(_otrace.wrap("construct_worker", worker_fn,
+                                 path=worker_path))
+        if worker_fn is not None else None
+    )
     try:
         res = _solve_tpu_inner(
             inst, seed, batch, rounds, sweeps, steps_per_round, t_hi,
             t_lo, n_devices, engine, checkpoint, profile_dir,
             time_limit_s, backend_fut, t0, bounds_fut,
             cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
-            budget,
+            budget, warm_start,
         )
     except Exception as e:
         # the degradation ladder's last rung (docs/RESILIENCE.md): a
@@ -427,7 +447,7 @@ def _solve_tpu(
         if multi or precompile or not _degradable(e):
             raise
         res = _host_fallback(inst, e, checkpoint, budget, t0,
-                             time_limit_s)
+                             time_limit_s, warm_start=warm_start)
     # robustness net: on TPU the sweep engine is the default at every
     # size, but ultra-tight small instances (exact rack bands + strict
     # per-partition diversity at high RF) can defeat its conflict-
@@ -460,13 +480,18 @@ def _solve_tpu(
         _olog.warn("engine_fallback_retry", engine="chain",
                    parts=inst.num_parts)
         with _otrace.span("retry", engine="chain"):
+            # the CALLER-OWNED budget threads through (not just its
+            # remaining seconds): a watch-mode Budget.cancel() landing
+            # mid-retry must retire the chain ladder at its next
+            # boundary too, not anneal out the whole remaining window
             res2 = solve_tpu(
                 inst, seed=seed, engine="chain", n_devices=n_devices,
                 batch=batch_arg, t_hi=t_hi_arg, t_lo=t_lo_arg,
                 checkpoint=checkpoint, profile_dir=profile_dir,
                 time_limit_s=remaining,
                 cert_min_savings_s=cert_min_savings_s,
-                pipeline=pipeline,
+                pipeline=pipeline, warm_start=warm_start,
+                budget=budget,
             )
         def rank(r):
             return (
@@ -533,7 +558,8 @@ def _degradable(e: BaseException) -> bool:
 
 def _host_fallback(inst: ProblemInstance, exc: BaseException,
                    checkpoint: str | None, budget: Budget, t0: float,
-                   time_limit_req: float | None) -> SolveResult:
+                   time_limit_req: float | None,
+                   warm_start=None) -> SolveResult:
     """The ladder's terminal rung (``anneal_to_construct``): the device
     search is unusable, so build the best host-side plan — greedy
     repair, displaced by a higher-ranking checkpoint when one exists
@@ -546,16 +572,21 @@ def _host_fallback(inst: ProblemInstance, exc: BaseException,
     _ladder.note_rung("anneal_to_construct", error=repr(exc)[:200])
     a = np.asarray(greedy_seed(inst), dtype=np.int32)
     resumed = False
+    warm_used = False
     if checkpoint:
         a_prev = ckpt.load(checkpoint, inst)
-        if a_prev is not None:
-            def rank(zz):
-                pen = sum(inst.violations(zz).values())
-                return (pen == 0, -pen, inst.preservation_weight(zz))
-
-            if rank(a_prev) >= rank(a):
-                a = a_prev
-                resumed = True
+        if a_prev is not None and _seed_rank(inst, a_prev) >= \
+                _seed_rank(inst, a):
+            a = a_prev
+            resumed = True
+    # a validated delta-API warm start (docs/WATCH.md) outranking the
+    # greedy repair keeps surviving replicas in place even on the
+    # degraded path — the last plan must not be forgotten just because
+    # the device died
+    if warm_start is not None and _seed_rank(inst, warm_start) >= \
+            _seed_rank(inst, a):
+        a = warm_start
+        warm_used = True
     if inst.is_feasible(a) and not budget.expired():
         a = inst.best_leader_assignment(a)
     viol = inst.violations(a)
@@ -583,6 +614,7 @@ def _host_fallback(inst: ProblemInstance, exc: BaseException,
             "seed_moves": int(inst.move_count(a)),
             "proved_optimal": proved,
             "resumed_from_checkpoint": resumed,
+            "warm_started": warm_used,
             "time_limit_s": time_limit_req,
             "timed_out": False,
             "early_stopped": False,
@@ -723,6 +755,41 @@ def _exact_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     return plan, False, False
 
 
+def _warm_certify_worker(inst: ProblemInstance, bounds_fut, warm_a,
+                         inner=None) -> tuple:
+    """Constructor-race body for the delta path (docs/WATCH.md): after
+    a cluster event the adapted previous plan is often already the
+    optimum — a drain evicts a few replicas and the hole-filling refill
+    is move-minimal by construction — so try certifying IT before any
+    LP decode. Joins the bounds prefetch like every constructor worker
+    (the certify bounds are memoized there); the exact leader reseat is
+    metadata-only and closes the one gap adaptation leaves. When the
+    warm candidate does not certify, falls through to ``inner`` — the
+    race worker this instance class would otherwise have run.
+
+    Returns the uniform 3-tuple ``(plan, certified, extends_greedy)``."""
+    try:
+        bounds_fut.result()
+    except Exception:
+        pass
+    a = np.ascontiguousarray(warm_a, dtype=np.int32)
+    viol = inst.violations(a)
+    # adaptation leaves leader counts wherever survival put them; the
+    # exact reseat repairs THAT band (transportation problem over fixed
+    # replica sets) — so gate only on the families reseat cannot touch
+    if all(v == 0 for k, v in viol.items() if k != "leader_balance"):
+        try:
+            a = inst.best_leader_assignment(a)
+        except Exception:
+            pass  # infeasible transportation: fall through uncertified
+        if inst.certify_optimal(a):
+            inst._construct_path = "warm"
+            return a, True, False
+    if inner is not None:
+        return inner()
+    return None, False, False
+
+
 class _BoundsTask:
     """Future-like handle on one bounds computation running on a daemon
     thread (``concurrent.futures`` workers are non-daemon and would
@@ -858,6 +925,15 @@ def _run_ladder(
     n = len(chunks)
     reseat_tries = 0  # boundary leader-reseat attempts (bounded)
     deadline = budget.deadline
+
+    def _deadline_now():
+        """Cancellation-aware deadline read: a Budget.cancel() from
+        another thread (a superseded watch-mode solve, docs/WATCH.md)
+        moves the effective deadline into the past, so the very next
+        boundary gate retires the ladder with its best-so-far plan."""
+        if budget.cancelled:
+            return time.perf_counter() - 1.0
+        return deadline
     # chunk 0's duration is compile-inclusive and a fallback chunk's
     # includes the XLA retry's first compile — both wildly overstate a
     # warm chunk, so neither may feed the warm estimate (a cold solve
@@ -1092,11 +1168,13 @@ def _run_ladder(
         ``--no-pipeline`` escape hatch)."""
         nonlocal sweep_state
         for i in range(n):
-            if deadline is not None and i >= 1:
+            dl = _deadline_now()
+            if dl is not None and i >= 1:
                 est = warm_chunk_s if warm_chunk_s is not None else prior_s
-                if est is not None and (
-                    deadline - time.perf_counter() < est * 0.9
-                ):  # next chunk won't fit
+                if time.perf_counter() > dl or (
+                    est is not None
+                    and dl - time.perf_counter() < est * 0.9
+                ):  # cancelled, or the next chunk won't fit
                     r.timed_out = True
                     return
             with _otrace.span("chunk", index=i) as _sp:
@@ -1117,7 +1195,8 @@ def _run_ladder(
                 chunk_attrs(_sp, i, disp_s, device_s, 0.0, h, r.scorer)
             if boundary(i):
                 return
-            if deadline is not None and time.perf_counter() > deadline:
+            dl = _deadline_now()
+            if dl is not None and time.perf_counter() > dl:
                 r.timed_out = i + 1 < n
                 return
 
@@ -1178,15 +1257,16 @@ def _run_ladder(
                 # certified (the in-flight speculation, if any, is
                 # abandoned — its results are never read) or done
                 return
-            if deadline is not None:
+            dl = _deadline_now()
+            if dl is not None:
                 # pipeline-aware deadline: chunk i+1 is already on the
                 # device; the clock decides whether to RETIRE it, not
                 # whether to dispatch it. Abandoning costs only
                 # speculative device work.
                 now = time.perf_counter()
                 est = warm_chunk_s if warm_chunk_s is not None else prior_s
-                if now > deadline or (
-                    est is not None and deadline - now < est * 0.9
+                if now > dl or (
+                    est is not None and dl - now < est * 0.9
                 ):
                     r.timed_out = True
                     return
@@ -1221,7 +1301,75 @@ def _run_ladder(
     return r
 
 
-def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint):
+def _seed_rank(inst, a) -> tuple:
+    """The one candidate rank every seed-selection path shares
+    (``_pick_seed`` and ``_host_fallback``): feasibility first, then
+    fewest violations, then preservation weight, then fewest moves as
+    the tie-break. One definition, so a rank change (the move-count
+    tie-break, ISSUE 7) cannot silently apply on one path and not the
+    other."""
+    pen = sum(inst.violations(a).values())
+    return (
+        pen == 0, -pen, inst.preservation_weight(a),
+        -int(inst.move_count(a)),
+    )
+
+
+def _validate_warm_start(inst, a) -> "np.ndarray | None":
+    """Admission check for a delta-API warm-start candidate
+    (docs/WATCH.md): shape/dtype/index-range and the hard structural
+    families (out-of-range slots, nulls in valid slots, duplicate
+    brokers within a partition) must hold — those the annealer's move
+    set preserves rather than repairs. Balance-band violations are fine
+    (fixing them is the search's job). A candidate whose ONLY violation
+    is the leader band gets the exact reseat applied here, at
+    admission: adaptation leaves leader counts wherever survival put
+    them, the reseat is metadata-only, and every downstream consumer —
+    the seed rank in ``_pick_seed``, the certify racer, the host
+    fallback — then sees the candidate at its true rank instead of
+    discarding a near-optimal plan over a violation the engine repairs
+    exactly anyway. A candidate that fails is REJECTED onto the
+    degradation ladder (``warm_start_rejected``) and the solve proceeds
+    from scratch; returns the validated int32 array or None."""
+    if a is None:
+        return None
+    reason = None
+    arr = np.asarray(a)
+    if arr.shape != (inst.num_parts, inst.max_rf):
+        reason = (
+            f"shape {arr.shape} != {(inst.num_parts, inst.max_rf)}"
+        )
+    elif not np.issubdtype(arr.dtype, np.integer):
+        reason = f"non-integer dtype {arr.dtype}"
+    else:
+        arr = np.ascontiguousarray(arr, dtype=np.int32)
+        viol = inst.violations(arr)
+        bad = {
+            k: viol[k]
+            for k in ("slot_out_of_range", "null_in_valid_slot",
+                      "duplicate_in_partition")
+            if viol[k]
+        }
+        if bad:
+            reason = f"structural violations {bad}"
+        elif viol["leader_balance"] and not any(
+            v for k, v in viol.items() if k != "leader_balance"
+        ):
+            try:
+                arr = np.ascontiguousarray(
+                    inst.best_leader_assignment(arr), dtype=np.int32
+                )
+            except Exception:
+                pass  # infeasible transportation: admit un-reseated
+    if reason is not None:
+        _ladder.note_rung("warm_start_rejected", reason=reason[:200])
+        _olog.warn("warm_start_rejected", reason=reason[:200])
+        return None
+    return arr
+
+
+def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint,
+               warm_start=None):
     """Stage 2 — warm-start selection: the host-side greedy repair
     (near-feasible, near-min-move), optionally displaced by a
     higher-ranking checkpoint plan (SURVEY.md §5 resume: the next solve
@@ -1231,8 +1379,14 @@ def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint):
     directly instead of recomputing the greedy repair — the extension
     can only outrank what it extends.
 
-    Returns ``(a_seed, resumed_from_checkpoint)``."""
+    A validated delta-API ``warm_start`` candidate (the previous plan
+    adapted to the post-event topology, docs/WATCH.md) competes under
+    the same rank and wins ties — surviving replicas stay put unless
+    the greedy repair is provably better.
+
+    Returns ``(a_seed, resumed_from_checkpoint, warm_started)``."""
     resumed = False
+    warm_used = False
     warm_extends = lp_warm is not None and lp_warm_extends
     a_seed = lp_warm if warm_extends else greedy_seed(inst)
     assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
@@ -1244,15 +1398,14 @@ def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint):
 
         Path(checkpoint).parent.mkdir(parents=True, exist_ok=True)
         a_prev = ckpt.load(checkpoint, inst)
-        if a_prev is not None:
-            def rank(a):
-                pen = sum(inst.violations(a).values())
-                w = inst.preservation_weight(a)
-                return (pen == 0, -pen, w)
-
-            if rank(a_prev) >= rank(a_seed):
-                a_seed = a_prev
-                resumed = True
+        if a_prev is not None and _seed_rank(inst, a_prev) >= \
+                _seed_rank(inst, a_seed):
+            a_seed = a_prev
+            resumed = True
+    if warm_start is not None and _seed_rank(inst, warm_start) >= \
+            _seed_rank(inst, a_seed):
+        a_seed = warm_start
+        warm_used = True
     if lp_warm is not None and not warm_extends:
         def _rank(zz):
             return (
@@ -1263,7 +1416,8 @@ def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint):
 
         if _rank(lp_warm) > _rank(a_seed):
             a_seed = lp_warm
-    return a_seed, resumed
+            warm_used = False
+    return a_seed, resumed, warm_used
 
 
 def _build_chunks(inst, engine, rounds, t_hi, t_lo, time_limit_s):
@@ -1460,7 +1614,7 @@ def _solve_tpu_inner(
     n_devices, engine, checkpoint, profile_dir, time_limit_s,
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
     lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
-    pipeline=True, budget: Budget | None = None,
+    pipeline=True, budget: Budget | None = None, warm_start=None,
 ) -> SolveResult:
     timed_out = False
     early_stopped = False
@@ -1542,15 +1696,20 @@ def _solve_tpu_inner(
 
     if certified_a is None:
         with _otrace.span("seed") as _sp:
-            a_seed, resumed = _pick_seed(inst, lp_warm, lp_warm_extends,
-                                         checkpoint)
+            a_seed, resumed, warm_started = _pick_seed(
+                inst, lp_warm, lp_warm_extends, checkpoint, warm_start
+            )
             if _sp is not None:
                 _sp.set(resumed_from_checkpoint=resumed,
+                        warm_started=warm_started,
                         warm_start_extends_greedy=bool(lp_warm_extends))
     else:
         _otrace.mark("seed", skipped=True)
         a_seed = certified_a  # never dispatched: the ladder is empty
         resumed = False
+        # the delta path's adapted plan can BE the certified plan: the
+        # warm-certify race worker tags its win (docs/WATCH.md)
+        warm_started = getattr(inst, "_construct_path", None) == "warm"
     # shape bucketing: lower the model padded up to its canonical bucket
     # so every instance in the bucket reuses one set of jitted/AOT
     # executables (solvers.tpu.bucket); padded rows are inert and every
@@ -1875,6 +2034,9 @@ def _solve_tpu_inner(
             "feasible": feasible,
             "violations": sum(viol.values()),
             "resumed_from_checkpoint": resumed,
+            # delta-API warm start (docs/WATCH.md): True when the
+            # adapted previous plan actually seeded this solve
+            "warm_started": warm_started,
             # best-score trajectory (max over shards, downsampled): the
             # convergence record SURVEY.md §5 calls for
             "score_curve": _downsample(best_curve, 32),
