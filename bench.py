@@ -75,6 +75,7 @@ _PROBE_CODE = (
     "w = os.environ.get('JAX_PLATFORMS')\n"
     "if w: jax.config.update('jax_platforms', w)\n"
     "print('PLATFORM=' + jax.devices()[0].platform)\n"
+    "print('NDEV=' + str(jax.device_count()))\n"
 )
 
 
@@ -82,34 +83,46 @@ _PROBE_CODE = (
 # parent side: backend probing + child orchestration (never imports jax)
 # --------------------------------------------------------------------------
 
-def _probe(env: dict, timeout: float) -> tuple[str | None, str | None]:
-    """Try backend init in a subprocess. Returns (platform, error)."""
+def _probe(env: dict, timeout: float) -> tuple[str | None, int | None,
+                                               str | None]:
+    """Try backend init in a subprocess. Returns
+    (platform, device_count, error)."""
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],
             env=env, timeout=timeout, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        return None, f"backend init timed out after {timeout:.0f}s"
+        return None, None, f"backend init timed out after {timeout:.0f}s"
     except OSError as e:  # pragma: no cover - exec failure
-        return None, f"probe exec failed: {e}"
+        return None, None, f"probe exec failed: {e}"
     if r.returncode == 0:
-        for line in reversed(r.stdout.splitlines()):
+        plat = ndev = None
+        for line in r.stdout.splitlines():
             if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1].strip(), None
-        return None, "probe printed no platform"
+                plat = line.split("=", 1)[1].strip()
+            elif line.startswith("NDEV="):
+                try:
+                    ndev = int(line.split("=", 1)[1].strip())
+                except ValueError:
+                    pass
+        if plat is not None:
+            return plat, ndev, None
+        return None, None, "probe printed no platform"
     tail = (r.stderr or r.stdout or "").strip().splitlines()
-    return None, " | ".join(tail[-3:])[-500:] or f"probe rc={r.returncode}"
+    return None, None, (
+        " | ".join(tail[-3:])[-500:] or f"probe rc={r.returncode}"
+    )
 
 
-def resolve_backend() -> tuple[dict, str, str | None]:
+def resolve_backend() -> tuple[dict, str, str | None, int | None]:
     """Pick an environment whose jax backend provably initializes.
 
     Attempt order: env as-is (site plugin may provide TPU), then
     ``JAX_PLATFORMS=''`` (automatic choice, tolerates plugin failure),
     then ``cpu`` (assumed always available). Returns
-    (env, platform, tpu_error) where tpu_error records why an
-    accelerator was NOT used, if so.
+    (env, platform, tpu_error, device_count) where tpu_error records
+    why an accelerator was NOT used, if so.
     """
     # attempt order, deduplicated: "env as-is" and "automatic" are the
     # same probe when JAX_PLATFORMS is unset/empty — don't hang twice
@@ -121,19 +134,43 @@ def resolve_backend() -> tuple[dict, str, str | None]:
         env = dict(os.environ)
         if override is not None:
             env["JAX_PLATFORMS"] = override
-        plat, err = _probe(env, PROBE_TIMEOUT_S)
+        plat, ndev, err = _probe(env, PROBE_TIMEOUT_S)
         if plat is not None:
-            return env, plat, first_err if plat == "cpu" else None
+            return env, plat, first_err if plat == "cpu" else None, ndev
         first_err = first_err or err
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # terminal fallback
     # probe the fallback too: when even CPU init is broken (bad jaxlib,
     # truncated venv) the harness must say so in the one JSON line with
     # an explicit platform field, not die mid-run in every child
-    plat, err = _probe(env, PROBE_TIMEOUT_S)
+    plat, ndev, err = _probe(env, PROBE_TIMEOUT_S)
     if plat is None:
         first_err = first_err or err
-    return env, plat or "cpu", first_err
+    return env, plat or "cpu", first_err, ndev
+
+
+def _env_stamp(platform: str, ndev: int | None, env: dict) -> dict:
+    """The comparability stamp (ISSUE 9 satellite): git SHA, device
+    count, platform and XLA_FLAGS travel IN the artifact so
+    ``obs/regress.py`` can refuse to compare numbers from incomparable
+    environments instead of reporting a bogus regression."""
+    sha = None
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        if r.returncode == 0:
+            sha = r.stdout.strip()[:12] or None
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return {
+        "git_sha": sha,
+        "platform": platform,
+        "devices": ndev,
+        "xla_flags": env.get("XLA_FLAGS", ""),
+    }
 
 
 def _run_child(
@@ -695,9 +732,16 @@ def run_replay_day(smoke: bool, seed: int) -> dict:
         warm["storm"]["sheds"]
         + int(warm["storm"]["final_plan_epoch"] != last_epoch)
     )
+    # flight-recorder evidence (docs/OBSERVABILITY.md): every event the
+    # registry solved landed ONE kind="delta" record via the manager's
+    # ambient tagging — the per-event cost ledger the SLO engine reads
+    from kafka_assignment_optimizer_tpu.obs import flight as _flight
+
+    delta_records = len(_flight.recent(kind="delta"))
     return {
         "platform": jax.devices()[0].platform,
         "events": len(seq) + 1 + len(storm),
+        "flight_delta_records": delta_records,
         "warm": warm,
         "cold": cold,
         "latency_win": (
@@ -808,6 +852,7 @@ def _compact_replay(rb: dict | None, err: str | None) -> dict:
         "warm_moves": w["moves_total"], "cold_moves": c["moves_total"],
         "storm_coalesced": w["storm"]["acks_coalesced"],
         "storm_dropped": rb["storm_dropped"],
+        "flight_delta_records": rb.get("flight_delta_records"),
     }
 
 
@@ -865,7 +910,8 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          search_cold_runs: dict | None = None,
          bucket_reuse: dict | None = None,
          batch_throughput: dict | None = None,
-         replay_day: dict | None = None) -> None:
+         replay_day: dict | None = None,
+         env_stamp: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
         line = {
@@ -876,6 +922,8 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
             "platform": platform,
             "error": (run_error or tpu_error or "unknown failure")[:300],
         }
+        if env_stamp:
+            line["env"] = env_stamp
         if tpu_error and run_error:
             line["tpu_error"] = tpu_error[:200]
         if scenarios:
@@ -909,6 +957,10 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         "proved_optimal": head.get("proved_optimal"),
         "engine": head.get("engine"),
     }
+    if env_stamp:
+        # the comparability stamp rides EVERY artifact (never shed by
+        # _print_final): obs/regress.py gates on it
+        line["env"] = env_stamp
     if cold_cached is not None:
         # a FRESH process re-solving the headline against the populated
         # persistent compile cache: the cold start a second process on
@@ -968,6 +1020,17 @@ def main() -> int:
                          "(auto-enabled when the backend is TPU)")
     ap.add_argument("--no-kernel", action="store_true",
                     help="suppress the auto-enabled kernel micro-bench")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="perf-regression gate (docs/OBSERVABILITY.md):"
+                         " diff two bench artifacts with noise-aware "
+                         "ratio thresholds and median-of-N aggregation;"
+                         " prints the verdict JSON and exits 0 ok / "
+                         "2 unreadable-artifact / 3 regression / "
+                         "4 incomparable-environments. Runs no solves.")
+    ap.add_argument("--compare-force", action="store_true",
+                    help="with --compare: proceed despite missing or "
+                         "mismatched env stamps")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--warm", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--batch-bench", action="store_true",
@@ -990,11 +1053,19 @@ def main() -> int:
     if args.child:
         return child_main(args)
 
+    if args.compare:
+        # the perf-regression gate: pure artifact diffing, no solves,
+        # no jax — safe in the parent process by construction
+        from kafka_assignment_optimizer_tpu.obs import regress
+
+        return regress.run_compare(args.compare[0], args.compare[1],
+                                   force=args.compare_force)
+
     if args.replay_day:
         # standalone replay-day mode (the soak smoke job's entry): one
         # child, one dedicated stdout line — no scenario sweep
         try:
-            env, platform, tpu_err = resolve_backend()
+            env, platform, tpu_err, ndev = resolve_backend()
         except Exception as e:  # noqa: BLE001 - must emit something
             print(json.dumps({"metric": "replay_day", "error": repr(e)[:300]}))
             return 0
@@ -1003,6 +1074,7 @@ def main() -> int:
         if rb is not None:
             print("[bench] REPLAY " + json.dumps(rb), file=sys.stderr)
         line = {"metric": "replay_day", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
                 **_compact_replay(rb, eb)}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
@@ -1010,7 +1082,7 @@ def main() -> int:
         return 0
 
     try:
-        env, platform, tpu_err = resolve_backend()
+        env, platform, tpu_err, ndev = resolve_backend()
     except Exception as e:  # noqa: BLE001 - must never die before emitting
         emit(None, "unknown", f"backend resolution failed: {e!r}",
              args.scenario)
@@ -1150,7 +1222,8 @@ def main() -> int:
          scenarios=rows if args.all else None, cold_cached=cold_cached,
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
          bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
-         replay_day=replay_day)
+         replay_day=replay_day,
+         env_stamp=_env_stamp(platform, ndev, env))
     return 0
 
 

@@ -99,13 +99,22 @@ def optimize(
     result = get_solver(solver)(inst, **solver_kwargs)
     plan = inst.decode(result.a)
     moves = move_diff(current, plan)
-    return OptimizeResult(
+    out = OptimizeResult(
         assignment=plan,
         moves=moves,
         solve=result,
         instance=inst,
         wall_clock_s=time.perf_counter() - t0,
     )
+    if result.solver != "tpu":
+        # the TPU engine records its own (richer) flight record; the
+        # exact oracles have no engine-level recorder, so the ledger
+        # entry lands here — small-instance delta/solve traffic that
+        # "auto" routes to MILP/native must not be an SLO blind spot
+        from .obs import flight as _flight
+
+        _flight.record_optimize(out)
+    return out
 
 
 def optimize_delta(

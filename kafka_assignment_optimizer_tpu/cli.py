@@ -95,6 +95,14 @@ def build_parser() -> argparse.ArgumentParser:
         "'solve_report' (implies --report)",
     )
     ap.add_argument(
+        "--flight-dir",
+        metavar="DIR",
+        help="solve-cost flight recorder (docs/OBSERVABILITY.md): "
+        "append one compact JSONL cost+quality record per solve under "
+        "this directory (crash-safe, auto-rotated; same as "
+        "KAO_FLIGHT_DIR). Inspect with 'kao-trace flight DIR'",
+    )
+    ap.add_argument(
         "--emit-lp",
         metavar="PATH",
         help="also write the lp_solve LP-format equation file (README.md:144-185)",
@@ -219,6 +227,20 @@ def _run(args: argparse.Namespace) -> int:
         from .analysis import sanitize as _sanitize
 
         _sanitize.enable()
+    import os
+
+    flight_dir = args.flight_dir or os.environ.get("KAO_FLIGHT_DIR")
+    if flight_dir:
+        from .obs import flight as _flight
+
+        try:
+            _flight.configure(flight_dir)
+        except OSError as e:
+            # name the flag in the message (main() renders ValueError
+            # as the CLI's clean "error: ..." exit-2 contract)
+            raise ValueError(
+                f"--flight-dir {flight_dir!r}: {e}"
+            ) from e
     if args.chaos:
         from .resilience import chaos as _chaos
 

@@ -105,12 +105,20 @@ def request_schema() -> dict:
             "GET /healthz": "service status, available solvers, "
                             "platform, executable-cache + queue state",
             "GET /metrics": "Prometheus text counters (kao_*, incl. "
-                            "kao_cache_*, kao_queue_* and the "
-                            "kao_phase_seconds phase histograms)",
+                            "kao_cache_*, kao_queue_*, the "
+                            "kao_phase_seconds / kao_solve_seconds "
+                            "histograms with exemplar trace IDs, and "
+                            "the kao_slo_* burn rates)",
             "GET /debug/solves": "recent solve-trace IDs; "
                                  "/debug/solves/<trace_id> returns that "
-                                 "solve's span-tree report "
+                                 "solve's span-tree report, "
+                                 "?format=chrome renders it as Chrome "
+                                 "trace-event JSON for Perfetto "
                                  "(docs/OBSERVABILITY.md)",
+            "GET /debug/slo": "SLO engine snapshot: per-class "
+                              "objectives, multi-window burn rates, "
+                              "worst-recent exemplars, and the tail "
+                              "of the flight-record stream",
             "GET /schema": "this document",
         },
         "example": {

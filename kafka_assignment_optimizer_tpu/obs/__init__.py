@@ -1,4 +1,4 @@
-"""Observability subsystem: solve-trace spans + structured logging.
+"""Observability subsystem: solve traces, flight records, SLOs.
 
 - ``obs.trace`` — dependency-free nested-span tracer. Every traced solve
   produces a structured *solve report* (span tree + annealing trajectory
@@ -7,9 +7,20 @@
   and costs one contextvar read per instrumentation site.
 - ``obs.log`` — single-line ``key=value`` structured logger; includes
   the active trace ID automatically.
+- ``obs.flight`` — per-solve flight recorder: one compact cost+quality
+  record per solve/delta/batch-lane, in-memory + crash-safe JSONL
+  (``--flight-dir``), feeding the ``kao_solve_seconds`` histograms
+  (with worst-recent exemplars) and the SLO engine.
+- ``obs.slo`` — sliding-window SLO engine with multi-window burn rates
+  (``kao_slo_*`` on /metrics, ``GET /debug/slo``).
+- ``obs.chrome`` — solve report -> Chrome trace-event JSON
+  (``?format=chrome``, Perfetto-loadable); ``obs.trace_cli`` is the
+  ``kao-trace`` offline dump/convert CLI.
+- ``obs.regress`` — noise-aware bench-artifact comparator
+  (``bench.py --compare OLD NEW``), the perf-regression gate.
 
-See ``docs/OBSERVABILITY.md`` for the trace-ID flow, the solve-report
-schema, and the metric naming conventions.
+See ``docs/OBSERVABILITY.md`` for the trace-ID flow, the flight-record
+schema, SLO configuration, and the metric naming conventions.
 """
 
 from . import log, trace  # noqa: F401

@@ -1,0 +1,605 @@
+"""Solve-cost flight recorder (docs/OBSERVABILITY.md).
+
+Every completed product solve — a ``/submit`` solve, a cluster-watch
+delta, or one lane of a coalesced batch — lands ONE compact JSON record
+here: bucket identity, per-phase seconds, the compile/device/host
+split, cache movement, degradation rungs, warm/cold provenance, and
+plan quality (objective, certification, move count, warm-certify hit).
+The record stream is what the SLO engine (``obs.slo``), the
+``kao_solve_seconds`` histograms, and the perf-regression trajectory
+all read from — ``/metrics`` says *that* p99 moved, the flight log says
+*which solves* moved it and *what they paid for*.
+
+Three sinks, fed by one :func:`record` call:
+
+- an **in-memory ring** (``RECENT``, bounded) behind ``GET /debug/slo``
+  and the ``kao-trace flight`` CLI;
+- the **SLO engine** (``obs.slo.ENGINE.observe``) driving burn-rate
+  windows and ``kao_slo_*`` metrics;
+- an optional **append-only JSONL file** under ``--flight-dir`` /
+  ``KAO_FLIGHT_DIR``. Appends are line-atomic best-effort; the reader
+  (:func:`iter_records`) tolerates a torn final line, so a ``kill -9``
+  mid-write costs at most one record. Rotation reuses the
+  ``watch/store.py`` discipline: the live file is ``os.replace``d to an
+  archived name (atomic on POSIX), a fresh live file is opened, and
+  archives beyond the cap are pruned oldest-first.
+
+Recording must NEVER fail a solve: every sink is wrapped, failures are
+counted (``kao_flight_write_errors_total``) and logged once per breed.
+
+Per-solve accounting (``start_accounting``/``note_compile``/
+``note_dispatch``): a contextvar accumulator the mesh dispatch layer
+feeds so each record carries ITS OWN compile seconds and cache
+hit/miss movement instead of a racy process-global delta. The watch
+manager tags delta solves via :func:`context` (kind + cluster/epoch),
+which the engine-level :func:`record_solve` merges in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from . import log as _olog
+from .trace import ExemplarHistogram
+
+# latency buckets for kao_solve_seconds{class=...}: warm solves sit
+# around 1 s, cold ~2-70 s (compile-bound), delta warm-certify in the
+# tens of ms — the ladder must resolve all three regimes
+SOLVE_BUCKETS = (0.025, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0,
+                 600.0)
+
+_RECENT_CAP = 512
+DEFAULT_MAX_BYTES = 8 << 20   # rotate the live JSONL past this
+DEFAULT_MAX_FILES = 4         # archived rotations kept
+
+
+# --------------------------------------------------------------------------
+# per-solve accounting (fed by parallel.mesh's dispatch/compile sites)
+# --------------------------------------------------------------------------
+
+
+class _SolveAcc:
+    __slots__ = ("compile_s", "compiles", "cache_hits", "cache_misses",
+                 "cache_fallbacks")
+
+    def __init__(self):
+        self.compile_s = 0.0
+        self.compiles = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_fallbacks = 0
+
+
+_ACC: contextvars.ContextVar = contextvars.ContextVar(
+    "kao_flight_acc", default=None
+)
+# delta/batch context: the watch manager (and any future wrapper) tags
+# the solves it drives with a kind + extra identity fields
+_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "kao_flight_ctx", default=None
+)
+
+
+def start_accounting():
+    """Begin a per-solve compile/cache accumulator on this context;
+    returns the token for :func:`end_accounting`."""
+    return _ACC.set(_SolveAcc())
+
+
+def accounting_active() -> bool:
+    """True when a solve accumulator is live on this context — the
+    engine's nesting guard: a retry/lane solve running INSIDE another
+    recorded solve must not land its own record (its compiles flow
+    into the outer accumulator instead)."""
+    return _ACC.get() is not None
+
+
+def end_accounting(token) -> _SolveAcc | None:
+    acc = _ACC.get()
+    try:
+        _ACC.reset(token)
+    except ValueError:  # crossed threads: keep the numbers anyway
+        pass
+    return acc
+
+
+def note_compile(seconds: float) -> None:
+    """One XLA compile attributed to the current solve (mesh calls
+    this next to its process-global counters)."""
+    acc = _ACC.get()
+    if acc is not None:
+        acc.compile_s += float(seconds)
+        acc.compiles += 1
+
+
+def note_dispatch(cache: str) -> None:
+    """One executable dispatch: ``cache`` is hit/miss/fallback."""
+    acc = _ACC.get()
+    if acc is None:
+        return
+    if cache == "hit":
+        acc.cache_hits += 1
+    elif cache == "miss":
+        acc.cache_misses += 1
+    else:
+        acc.cache_fallbacks += 1
+
+
+@contextlib.contextmanager
+def context(kind: str, **extra):
+    """Tag solves under this block with ``kind`` (e.g. ``delta``) and
+    identity fields (cluster, epoch) merged into their records."""
+    tok = _CTX.set({"kind": kind, **extra})
+    try:
+        yield
+    finally:
+        try:
+            _CTX.reset(tok)
+        except ValueError:
+            pass
+
+
+# --------------------------------------------------------------------------
+# kao_solve_seconds{class=...} histograms with worst-recent exemplars
+# (the shared machinery lives in obs.trace.ExemplarHistogram so the
+# bucket math and exemplar policy cannot drift from kao_phase_seconds)
+# --------------------------------------------------------------------------
+
+SOLVE_HIST = ExemplarHistogram(SOLVE_BUCKETS)
+
+
+def observe_solve(cls: str, seconds: float,
+                  trace_id: str | None = None) -> None:
+    SOLVE_HIST.observe(cls, seconds, trace_id=trace_id)
+
+
+def solve_snapshot() -> dict[str, dict]:
+    """{class: {"buckets": [(le_str, cumulative), ...], "count": n,
+    "sum": s}} — same shape as ``obs.trace.phase_snapshot``."""
+    return SOLVE_HIST.snapshot()
+
+
+def solve_exemplars() -> list[dict]:
+    """Live worst-recent exemplars, one per non-empty (class, bucket):
+    ``{"class", "le", "trace_id", "value", "age_s"}``."""
+    return SOLVE_HIST.exemplars("class")
+
+
+def reset_solve_stats() -> None:
+    SOLVE_HIST.reset()
+
+
+# --------------------------------------------------------------------------
+# the recorder
+# --------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Append-only JSONL sink with atomic rotation. Disabled (memory
+    ring + SLO feed only) until :meth:`configure` names a directory."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dir: str | None = None
+        self._fh = None
+        self._bytes = 0
+        self.max_bytes = DEFAULT_MAX_BYTES
+        self.max_files = DEFAULT_MAX_FILES
+        self.records_total = 0
+        self.write_errors_total = 0
+        self.rotations_total = 0
+        self._seq = 1
+        self._warned = False
+
+    @property
+    def path(self) -> str | None:
+        return (
+            os.path.join(self._dir, "flight.jsonl") if self._dir else None
+        )
+
+    def configure(self, directory: str | None,
+                  max_bytes: int | None = None,
+                  max_files: int | None = None) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            self._dir = directory or None
+            if max_bytes is not None:
+                self.max_bytes = max(int(max_bytes), 4096)
+            if max_files is not None:
+                self.max_files = max(int(max_files), 1)
+            if self._dir:
+                os.makedirs(self._dir, exist_ok=True)
+                # resume the archive sequence past any prior process's
+                # rotations so names stay unique and time-ordered
+                self._seq = 1 + max(
+                    (self._archive_seq(f)
+                     for f in os.listdir(self._dir)),
+                    default=0,
+                )
+                # probe-open the live file NOW: an existing-but-
+                # unwritable directory must be a boot-time error
+                # (serve maps it to ap.error, the CLI to exit 2), not
+                # a per-solve warn loop silently dropping the ledger
+                self._open_locked()
+
+    @staticmethod
+    def _archive_seq(name: str) -> int:
+        if name.startswith("flight-") and name.endswith(".jsonl"):
+            try:
+                return int(name[len("flight-"):-len(".jsonl")])
+            except ValueError:
+                return 0
+        return 0
+
+    def enabled(self) -> bool:
+        return self._dir is not None
+
+    def _open_locked(self) -> None:
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._bytes = self._fh.tell()
+
+    def _rotate_locked(self) -> None:
+        """watch/store.py discipline: fsync the live file, atomically
+        ``os.replace`` it to an archived name, reopen fresh, prune
+        archives past the cap oldest-first."""
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        # zero-padded sequence names sort lexicographically in write
+        # order (and before the live "flight.jsonl": '-' < '.'), so
+        # iter_records over the directory replays chronologically
+        dst = os.path.join(self._dir, f"flight-{self._seq:08d}.jsonl")
+        self._seq += 1
+        os.replace(self.path, dst)
+        self.rotations_total += 1
+        archives = sorted(
+            f for f in os.listdir(self._dir)
+            if f.startswith("flight-") and f.endswith(".jsonl")
+        )
+        for old in archives[: max(len(archives) - self.max_files, 0)]:
+            try:
+                os.remove(os.path.join(self._dir, old))
+            except OSError:
+                pass
+        self._open_locked()
+
+    def write(self, rec: dict) -> None:
+        """Append one record; never raises (errors are counted and
+        logged once). ``records_total`` counts SUCCESSFUL appends only
+        — with no directory configured (or a failed write) it stays
+        put, so the counter always agrees with the JSONL contents."""
+        with self._lock:
+            if self._dir is None:
+                return
+            try:
+                if self._fh is None:
+                    self._open_locked()
+                line = json.dumps(rec, separators=(",", ":"),
+                                  default=str)
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self._bytes += len(line) + 1
+                self.records_total += 1
+                if self._bytes >= self.max_bytes:
+                    self._rotate_locked()
+            except OSError as e:
+                self.write_errors_total += 1
+                self._fh = None  # reopen on the next write
+                if not self._warned:
+                    self._warned = True
+                    _olog.warn("flight_write_failed",
+                               path=self.path, error=repr(e)[:200])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": int(self._dir is not None),
+                "dir": self._dir,
+                "records_total": self.records_total,
+                "write_errors_total": self.write_errors_total,
+                "rotations_total": self.rotations_total,
+                "max_bytes": self.max_bytes,
+                "max_files": self.max_files,
+            }
+
+
+RECORDER = FlightRecorder()
+# the in-memory tail of the record stream (GET /debug/slo, tests)
+_RECENT_LOCK = threading.Lock()
+RECENT: deque = deque(maxlen=_RECENT_CAP)
+# records that entered the STREAM (ring + SLO + histograms) — distinct
+# from the recorder's records_total, which counts only disk appends
+_STREAM_TOTAL = [0]
+
+
+def configure(directory: str | None, **kw) -> None:
+    RECORDER.configure(directory, **kw)
+
+
+def enabled() -> bool:
+    return RECORDER.enabled()
+
+
+def snapshot() -> dict:
+    with _RECENT_LOCK:
+        stream = _STREAM_TOTAL[0]
+    return {**RECORDER.snapshot(), "stream_records_total": stream}
+
+
+def recent(n: int | None = None, kind: str | None = None) -> list[dict]:
+    with _RECENT_LOCK:
+        recs = list(RECENT)
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs[-n:] if n else recs
+
+
+def reset_recent() -> None:
+    with _RECENT_LOCK:
+        RECENT.clear()
+
+
+def record(rec: dict) -> None:
+    """Land one flight record on every sink. Never raises."""
+    try:
+        with _RECENT_LOCK:
+            RECENT.append(rec)
+            _STREAM_TOTAL[0] += 1
+        RECORDER.write(rec)
+        observe_solve(rec.get("kind") or "solve",
+                      float(rec.get("wall_s") or 0.0),
+                      rec.get("trace_id"))
+        from . import slo as _slo
+
+        _slo.ENGINE.observe_record(rec)
+    except Exception as e:  # telemetry must never fail a solve
+        _olog.warn("flight_record_failed", error=repr(e)[:200])
+
+
+def _split(stats: dict, acc: _SolveAcc | None, wall_s: float) -> dict:
+    """The compile/device/host wall split: device + dispatch seconds
+    come from the ladder accounting, compile from this solve's own
+    accumulator, host is the remainder — the components sum to
+    ~wall_s. Dispatch is compile-INCLUSIVE on first contact
+    (docs/PIPELINE.md), so the remainder subtracts
+    ``max(dispatch, compile)`` rather than both: subtracting both
+    would double-count the compile that happened inside the enqueue
+    window."""
+    device_s = float(stats.get("device_s") or 0.0)
+    dispatch_s = float(stats.get("dispatch_s") or 0.0)
+    compile_s = round(acc.compile_s, 4) if acc else 0.0
+    host_s = max(wall_s - device_s - max(dispatch_s, compile_s), 0.0)
+    return {
+        "compile_s": compile_s,
+        "device_s": round(device_s, 4),
+        "dispatch_s": round(dispatch_s, 4),
+        "host_s": round(host_s, 4),
+    }
+
+
+def record_solve(result, inst=None, acc: _SolveAcc | None = None,
+                 *, kind: str | None = None,
+                 wall_s: float | None = None,
+                 extra: dict | None = None) -> dict | None:
+    """Build and land the compact record for one finished engine solve
+    (``result``: a SolveResult). The ambient :func:`context` supplies
+    the kind + identity for delta solves; ``kind`` overrides (batch
+    lanes). Returns the record (tests), or None on failure — recording
+    never raises into the solve path."""
+    try:
+        st = result.stats
+        ctx = _CTX.get() or {}
+        k = kind or ctx.get("kind") or "solve"
+        wall = float(wall_s if wall_s is not None
+                     else result.wall_clock_s)
+        bucket = None
+        if inst is not None:
+            bucket = [
+                int(inst.num_brokers), int(inst.num_racks),
+                int(st["bucket_parts"]) if st.get("bucket_parts")
+                is not None else None,
+                int(st["bucket_rf"]) if st.get("bucket_rf")
+                is not None else None,
+            ]
+        rep = st.get("solve_report") or {}
+        phases = {
+            p: round(float(v), 4)
+            for p, v in (rep.get("phases") or {}).items()
+        } or {
+            # untraced solve: the engine's own coarse phase clocks
+            "seed": round(float(st.get("seed_s") or 0.0), 4),
+            "ladder": round(float(st.get("anneal_s") or 0.0), 4),
+            "polish": round(float(st.get("polish_s") or 0.0), 4),
+        }
+        construct_path = st.get("construct_path")
+        rec = {
+            "ts": round(time.time(), 3),
+            "kind": k,
+            "trace_id": st.get("trace_id"),
+            "engine": st.get("engine"),
+            "bucket": bucket,
+            "wall_s": round(wall, 4),
+            "phases": phases,
+            "split": _split(st, acc, wall),
+            "cache": {
+                "hits": acc.cache_hits if acc else 0,
+                "misses": acc.cache_misses if acc else 0,
+                "fallbacks": acc.cache_fallbacks if acc else 0,
+                "compiles": acc.compiles if acc else 0,
+            },
+            "degradations": list(st.get("degradations") or ()),
+            "warm": {
+                # warm path = no compile paid by THIS solve
+                "warm_path": not (acc.compiles if acc else 0),
+                "warm_started": bool(st.get("warm_started")),
+                "warm_certify": construct_path == "warm",
+                "resumed": bool(st.get("resumed_from_checkpoint")),
+                "construct_path": construct_path,
+            },
+            "quality": {
+                "feasible": bool(st.get("feasible")),
+                "certified": bool(st.get("proved_optimal")),
+                "moves": st.get("moves"),
+                "objective": getattr(result, "objective", None),
+                "timed_out": bool(st.get("timed_out")),
+                "degraded": bool(st.get("degraded")),
+            },
+        }
+        for key, v in {**ctx, **(extra or {})}.items():
+            if key != "kind" and key not in rec:
+                rec[key] = v
+        record(rec)
+        return rec
+    except Exception as e:
+        _olog.warn("flight_record_failed", error=repr(e)[:200])
+        return None
+
+
+def record_failure(inst, acc: _SolveAcc | None, wall_s: float,
+                   error: BaseException, *,
+                   kind: str | None = None) -> dict | None:
+    """The record for a solve that RAISED: no plan, no quality — but
+    the failure must burn the SLO quality budget and land in the
+    ledger, or a total outage of the solve path reads as zero burn
+    ("the page condition never fires because nothing completed").
+    Never raises."""
+    try:
+        ctx = _CTX.get() or {}
+        rec = {
+            "ts": round(time.time(), 3),
+            "kind": kind or ctx.get("kind") or "solve",
+            "trace_id": None,
+            "engine": None,
+            "bucket": (
+                [int(inst.num_brokers), int(inst.num_racks), None,
+                 None] if inst is not None else None
+            ),
+            "wall_s": round(float(wall_s), 4),
+            "phases": {},
+            "split": _split({}, acc, float(wall_s)),
+            "cache": {
+                "hits": acc.cache_hits if acc else 0,
+                "misses": acc.cache_misses if acc else 0,
+                "fallbacks": acc.cache_fallbacks if acc else 0,
+                "compiles": acc.compiles if acc else 0,
+            },
+            "degradations": [],
+            "warm": {"warm_path": False, "warm_started": False,
+                     "warm_certify": False, "resumed": False,
+                     "construct_path": None},
+            "quality": {"feasible": False, "certified": False,
+                        "moves": None, "objective": None,
+                        "timed_out": False, "degraded": False},
+            "error": repr(error)[:200],
+        }
+        from . import trace as _otrace
+
+        rec["trace_id"] = _otrace.current_trace_id()
+        for key, v in ctx.items():
+            if key != "kind" and key not in rec:
+                rec[key] = v
+        record(rec)
+        return rec
+    except Exception as e:
+        _olog.warn("flight_record_failed", error=repr(e)[:200])
+        return None
+
+
+def record_optimize(result) -> dict | None:
+    """Reduced record for a non-TPU (exact-oracle) solve —
+    ``api.optimize`` calls this when the resolved solver has no
+    engine-level recorder, so exact-solver traffic (the small-instance
+    path ``auto`` routes to MILP/native) still lands in the SLO ledger.
+    Phase/split/cache columns are annealing-engine concepts and stay
+    empty; quality is computed against the same oracle every solver
+    answers to. Never raises."""
+    try:
+        solve = result.solve
+        inst = result.instance
+        viol = inst.violations(solve.a)
+        from . import trace as _otrace
+
+        ctx = _CTX.get() or {}
+        rec = {
+            "ts": round(time.time(), 3),
+            "kind": ctx.get("kind") or "solve",
+            "trace_id": (solve.stats.get("trace_id")
+                         or _otrace.current_trace_id()),
+            "engine": solve.solver,
+            "bucket": [int(inst.num_brokers), int(inst.num_racks),
+                       None, None],
+            "wall_s": round(float(result.wall_clock_s), 4),
+            "phases": {},
+            "split": {"compile_s": 0.0, "device_s": 0.0,
+                      "dispatch_s": 0.0,
+                      "host_s": round(float(result.wall_clock_s), 4)},
+            "cache": {"hits": 0, "misses": 0, "fallbacks": 0,
+                      "compiles": 0},
+            "degradations": list(solve.stats.get("degradations") or ()),
+            "warm": {
+                "warm_path": True,  # exact solvers never compile
+                "warm_started": False,
+                "warm_certify": False,
+                "resumed": False,
+                "construct_path": solve.solver,
+            },
+            "quality": {
+                "feasible": all(v == 0 for v in viol.values()),
+                "certified": bool(solve.optimal),
+                "moves": result.moves.replica_moves,
+                "objective": solve.objective,
+                "timed_out": False,
+                "degraded": bool(solve.stats.get("degraded")),
+            },
+        }
+        for key, v in ctx.items():
+            if key != "kind" and key not in rec:
+                rec[key] = v
+        record(rec)
+        return rec
+    except Exception as e:
+        _olog.warn("flight_record_failed", error=repr(e)[:200])
+        return None
+
+
+def iter_records(path: str):
+    """Yield records from one flight JSONL file (or every file,
+    archives first, when ``path`` is a directory). A torn/corrupt line
+    — the kill -9 tail — is skipped, never fatal."""
+    paths = [path]
+    if os.path.isdir(path):
+        names = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("flight") and f.endswith(".jsonl")
+        )
+        # archives (flight-*) sort before the live file (flight.jsonl)
+        # lexicographically already: '-' < '.'
+        paths = [os.path.join(path, f) for f in names]
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        continue  # torn tail / bit rot: skip
+        except OSError:
+            continue

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
 import os
 import threading
 import time
@@ -308,53 +309,138 @@ def finish(tr: Trace | None) -> dict | None:
 # --------------------------------------------------------------------------
 
 PHASE_BUCKETS = (0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
+# an exemplar older than this is replaced by ANY fresh observation in
+# its bucket at write time, and dropped from snapshots at read time —
+# a stale worst-case must not keep advertising a trace the report ring
+# has already evicted
+EXEMPLAR_TTL_S = 600.0
 
-_PHASE_LOCK = threading.Lock()
-# phase -> [per-bucket counts..., count, sum]
-_PHASES: dict[str, list] = {}
 
+class ExemplarHistogram:
+    """A keyed Prometheus-style histogram with a worst-recent exemplar
+    per (key, containment bucket) — shared by the per-phase latency
+    histograms here and the per-class solve histograms in
+    ``obs.flight``, so the bucket math, the exemplar policy, and the
+    snapshot shapes can never drift apart.
 
-def observe_phase(phase: str, seconds: float) -> None:
-    s = float(seconds)
-    with _PHASE_LOCK:
-        row = _PHASES.get(phase)
-        if row is None:
-            row = _PHASES[phase] = [0] * len(PHASE_BUCKETS) + [0, 0.0]
-        for i, le in enumerate(PHASE_BUCKETS):
+    Exemplar policy: a bigger observation always takes its bucket's
+    exemplar; a smaller one only replaces an exemplar older than
+    ``ttl_s``. Reads (:meth:`exemplars`) drop entries past the TTL
+    entirely — a quiet bucket must not advertise a dead trace ID
+    forever."""
+
+    def __init__(self, buckets: tuple, ttl_s: float = EXEMPLAR_TTL_S):
+        self.buckets = tuple(buckets)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        # key -> [per-bucket cumulative counts..., count, sum]
+        self._rows: dict[str, list] = {}
+        # (key, bucket_index) -> (value, trace_id, unix_ts); index
+        # len(buckets) is the +Inf bucket (containment, per the
+        # OpenMetrics exemplar convention — non-cumulative)
+        self._exemplars: dict[tuple, tuple] = {}
+
+    def observe(self, key: str, seconds: float,
+                trace_id: str | None = None) -> None:
+        s = float(seconds)
+        idx = len(self.buckets)
+        for i, le in enumerate(self.buckets):
             if s <= le:
+                idx = i
+                break
+        now = time.time()
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                row = self._rows[key] = (
+                    [0] * len(self.buckets) + [0, 0.0]
+                )
+            for i in range(idx, len(self.buckets)):
                 row[i] += 1
-        row[-2] += 1
-        row[-1] += s
+            row[-2] += 1
+            row[-1] += s
+            if trace_id:
+                cur = self._exemplars.get((key, idx))
+                if (cur is None or s >= cur[0]
+                        or now - cur[2] > self.ttl_s):
+                    self._exemplars[(key, idx)] = (s, trace_id, now)
+
+    def snapshot(self) -> dict[str, dict]:
+        """{key: {"buckets": [(le_str, cumulative_count), ...],
+        "count": n, "sum": seconds}} — buckets cumulative per the
+        Prometheus histogram convention (+Inf bucket is ``count``)."""
+        with self._lock:
+            rows = {k: list(v) for k, v in self._rows.items()}
+        out = {}
+        for key, row in rows.items():
+            out[key] = {
+                "buckets": [
+                    (repr(le), row[i])
+                    for i, le in enumerate(self.buckets)
+                ],
+                "count": row[-2],
+                "sum": round(row[-1], 6),
+            }
+        return out
+
+    def exemplars(self, label: str) -> list[dict]:
+        """Live (younger than the TTL) worst-recent exemplars, one per
+        non-empty (key, bucket): ``{label, "le", "trace_id", "value",
+        "age_s"}``."""
+        now = time.time()
+        with self._lock:
+            items = list(self._exemplars.items())
+        out = []
+        for (key, idx), (val, tid, ts) in sorted(items):
+            age = now - ts
+            if age > self.ttl_s:
+                continue  # the linked report is long evicted
+            le = (
+                repr(self.buckets[idx]) if idx < len(self.buckets)
+                else "+Inf"
+            )
+            out.append({
+                label: key, "le": le, "trace_id": tid,
+                "value": round(val, 6), "age_s": round(age, 1),
+            })
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._exemplars.clear()
+
+
+PHASE_HIST = ExemplarHistogram(PHASE_BUCKETS)
+
+
+def observe_phase(phase: str, seconds: float,
+                  trace_id: str | None = None) -> None:
+    PHASE_HIST.observe(phase, seconds, trace_id=trace_id)
 
 
 def phase_snapshot() -> dict[str, dict]:
-    """{phase: {"buckets": [(le_str, cumulative_count), ...],
-    "count": n, "sum": seconds}} — buckets are cumulative per the
-    Prometheus histogram convention (the +Inf bucket is ``count``)."""
-    with _PHASE_LOCK:
-        rows = {k: list(v) for k, v in _PHASES.items()}
-    out = {}
-    for phase, row in rows.items():
-        out[phase] = {
-            "buckets": [
-                (repr(le), row[i]) for i, le in enumerate(PHASE_BUCKETS)
-            ],
-            "count": row[-2],
-            "sum": round(row[-1], 6),
-        }
-    return out
+    return PHASE_HIST.snapshot()
+
+
+def phase_exemplars() -> list[dict]:
+    """Worst-recent exemplars per (phase, bucket) — the metric-to-
+    trace link rendered next to ``kao_phase_seconds`` on /metrics."""
+    return PHASE_HIST.exemplars("phase")
 
 
 def reset_phase_stats() -> None:
-    with _PHASE_LOCK:
-        _PHASES.clear()
+    PHASE_HIST.reset()
 
 
 def _observe_tree(root: Span) -> None:
     """Feed every finished, non-skipped span into the phase histograms
     (span names are a small fixed vocabulary: the pipeline phases plus
-    chunk/dispatch/compile/device_transfer)."""
+    chunk/dispatch/compile/device_transfer). Each observation carries
+    the trace ID so the histogram's worst-recent exemplar links back
+    to this solve's report."""
     lock = root.trace._lock
+    tid = root.trace.trace_id
     stack = [root]
     while stack:
         sp = stack.pop()
@@ -363,7 +449,7 @@ def _observe_tree(root: Span) -> None:
             skipped = sp.attrs.get("skipped")
         if sp is root or sp.end is None or skipped:
             continue
-        observe_phase(sp.name, sp.end - sp.start)
+        observe_phase(sp.name, sp.end - sp.start, trace_id=tid)
 
 
 # --------------------------------------------------------------------------
@@ -371,39 +457,127 @@ def _observe_tree(root: Span) -> None:
 # --------------------------------------------------------------------------
 
 
-class ReportRing:
-    """Bounded most-recent-solve-reports map, keyed by trace ID."""
+def _truncate_report(report: dict, max_bytes: int) -> tuple[dict, int]:
+    """Cap one report's serialized size by pruning the DEEPEST span
+    level first (a pathological ladder's ten-thousand chunk children go
+    before the phase skeleton an operator actually reads). Each pruned
+    parent records ``spans_dropped``; a touched report is marked
+    ``"truncated": true``. Returns ``(report, serialized_size)`` —
+    the original object is never mutated (finish() hands the same dict
+    to the caller's ``stats["solve_report"]``)."""
+    size = len(json.dumps(report, default=str))
+    if size <= max_bytes:
+        return report, size
+    report = json.loads(json.dumps(report, default=str))  # private copy
+    report["truncated"] = True
 
-    def __init__(self, capacity: int = 128):
+    def depth_of(span: dict) -> int:
+        kids = span.get("spans") or ()
+        return 1 + max((depth_of(c) for c in kids), default=0)
+
+    def prune_at(span: dict, level: int) -> None:
+        kids = span.get("spans") or ()
+        if level <= 1:
+            if kids:
+                span["spans_dropped"] = (
+                    span.get("spans_dropped", 0) + len(kids)
+                )
+                del span["spans"]
+            return
+        for c in kids:
+            prune_at(c, level - 1)
+
+    root = report.get("spans")
+    while size > max_bytes:
+        if isinstance(root, dict):
+            d = depth_of(root)
+            if d > 1:
+                prune_at(root, d - 1)
+                size = len(json.dumps(report, default=str))
+                continue
+        # span tree exhausted: shed the trajectory, then give up (the
+        # scalar skeleton is as small as this report gets)
+        if report.pop("annealing", None) is None:
+            break
+        size = len(json.dumps(report, default=str))
+    return report, size
+
+
+class ReportRing:
+    """Bounded most-recent-solve-reports map, keyed by trace ID.
+
+    Two bounds, both resident-memory caps rather than entry counts
+    alone: ``capacity`` entries, and ``max_total_bytes`` of serialized
+    payload (oldest evicted first). Each report is additionally capped
+    at ``max_report_bytes`` via :func:`_truncate_report` — a single
+    pathological ladder (tens of thousands of chunk spans) cannot grow
+    the ring unbounded."""
+
+    def __init__(self, capacity: int = 128,
+                 max_report_bytes: int = 256 << 10,
+                 max_total_bytes: int = 8 << 20):
         self.capacity = max(1, int(capacity))
+        self.max_report_bytes = max(4096, int(max_report_bytes))
+        self.max_total_bytes = max(self.max_report_bytes,
+                                   int(max_total_bytes))
         self._lock = threading.Lock()
-        self._d: OrderedDict[str, dict] = OrderedDict()
+        self._d: OrderedDict[str, tuple[dict, int]] = OrderedDict()
+        self._bytes = 0
+        self.truncated_total = 0
 
     def put(self, report: dict) -> None:
         tid = report.get("trace_id")
         if not tid:
             return
+        report, size = _truncate_report(report, self.max_report_bytes)
         with self._lock:
-            self._d.pop(tid, None)
-            self._d[tid] = report
-            while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
+            if report.get("truncated"):
+                self.truncated_total += 1
+            old = self._d.pop(tid, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._d[tid] = (report, size)
+            self._bytes += size
+            while self._d and (
+                len(self._d) > self.capacity
+                or self._bytes > self.max_total_bytes
+            ):
+                if len(self._d) == 1:
+                    break  # always retain the newest report
+                _, (_, osz) = self._d.popitem(last=False)
+                self._bytes -= osz
 
     def get(self, trace_id: str) -> dict | None:
         with self._lock:
-            return self._d.get(trace_id)
+            row = self._d.get(trace_id)
+        return None if row is None else row[0]
 
     def ids(self) -> list[str]:
         """Most recent first."""
         with self._lock:
             return list(reversed(self._d))
 
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "reports": len(self._d),
+                "bytes": self._bytes,
+                "capacity": self.capacity,
+                "max_report_bytes": self.max_report_bytes,
+                "max_total_bytes": self.max_total_bytes,
+                "truncated_total": self.truncated_total,
+            }
 
-def _ring_capacity() -> int:
+
+def _env_int(name: str, default: int) -> int:
     try:
-        return int(os.environ.get("KAO_TRACE_RING", "") or 128)
+        return int(os.environ.get(name, "") or default)
     except ValueError:
-        return 128
+        return default
 
 
-RECENT = ReportRing(_ring_capacity())
+RECENT = ReportRing(
+    _env_int("KAO_TRACE_RING", 128),
+    max_report_bytes=_env_int("KAO_TRACE_REPORT_BYTES", 256 << 10),
+    max_total_bytes=_env_int("KAO_TRACE_RING_BYTES", 8 << 20),
+)

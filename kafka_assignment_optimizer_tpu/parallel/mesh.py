@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analysis import sanitize as _san
+from ..obs import flight as _flight
 from ..obs import trace as _otrace
 from ..resilience import budget as _rbudget
 from ..resilience import chaos as _chaos
@@ -180,6 +181,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                 with _otrace.span("dispatch", cache="hit"):
                     out = ex(*args)
                 _CACHE_STATS.record_exec(True)
+                _flight.note_dispatch("hit")
                 return out
             except Exception:
                 with _EXECUTABLES_LOCK:
@@ -190,6 +192,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                     # failing — the jit retry cannot run on dead args
                     raise
                 _CACHE_STATS.record_exec(False, fallback=True)
+                _flight.note_dispatch("fallback")
                 _ladder.note_rung("aot_to_jit", cause="exec_failed")
                 with _otrace.span("dispatch", cache="fallback"):
                     return fn(*args)
@@ -201,6 +204,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
         # which serializes on jax's own compile cache anyway)
         if not inflight.wait(timeout=600.0):
             _CACHE_STATS.record_exec(False, fallback=True)
+            _flight.note_dispatch("fallback")
             _ladder.note_rung("aot_to_jit", cause="compile_wedged")
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
@@ -225,10 +229,17 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             if not _args_alive(args):
                 raise
             _CACHE_STATS.record_exec(False, fallback=True)
+            _flight.note_dispatch("fallback")
             _ladder.note_rung("aot_to_jit", cause="compile_failed")
             with _otrace.span("dispatch", cache="fallback"):
                 return fn(*args)
-        _CACHE_STATS.record_exec(False, compile_s=time.perf_counter() - t0)
+        compile_s = time.perf_counter() - t0
+        _CACHE_STATS.record_exec(False, compile_s=compile_s)
+        # per-solve attribution (obs.flight): the ambient accumulator
+        # gives THIS solve's flight record its own compile seconds and
+        # cache movement, not a racy process-global delta
+        _flight.note_compile(compile_s)
+        _flight.note_dispatch("miss")
         evicted = []
         with _EXECUTABLES_LOCK:
             _EXECUTABLES[key] = ex

@@ -128,7 +128,10 @@ from . import landing
 from .analysis import sanitize as _sanitize_mod
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
+from .obs import chrome as _ochrome
+from .obs import flight as _oflight
 from .obs import log as _olog
+from .obs import slo as _oslo
 from .obs import trace as _otrace
 from .resilience import breaker as _breaker
 from .resilience import budget as _rbudget
@@ -239,7 +242,44 @@ OBS = {
     "trace": True,
     "profile_dir": None,
     "profile_solves": 1,
+    # continuous-performance observatory (docs/OBSERVABILITY.md):
+    # --flight-dir persists one compact flight record per
+    # solve/delta/batch-lane (obs.flight); the SLO engine (obs.slo)
+    # runs over the record stream either way
+    "flight_dir": None,
 }
+# process start, for the kao_uptime_seconds gauge
+_START_UNIX = time.time()
+# kao_build_info labels, resolved once (jax.devices() initializes the
+# backend; cache the answer so /metrics scrapes stay cheap)
+_BUILD_INFO: dict = {}
+
+
+def _build_info(resolve: bool = False) -> dict:
+    """kao_build_info labels. ``/metrics`` reads the CACHE only — a
+    monitoring scrape must never be the thing that pays jax backend
+    init (multi-second on TPU, on the handler thread). Resolution
+    happens where init is already deliberate: ``handle_healthz``
+    (which calls ``jax.devices()`` anyway) passes ``resolve=True``,
+    so the labels fill on the first health probe."""
+    if not _BUILD_INFO and resolve:
+        try:
+            import jax
+
+            from . import __version__
+
+            _BUILD_INFO.update({
+                "version": __version__,
+                "jax": jax.__version__,
+                "backend": jax.default_backend(),
+                "devices": str(jax.device_count()),
+            })
+        except Exception:  # init failed: uncached, retried next probe
+            pass
+    if not _BUILD_INFO:
+        return {"version": "unknown", "jax": "unknown",
+                "backend": "unknown", "devices": "0"}
+    return dict(_BUILD_INFO)
 _PROFILE_LOCK = threading.Lock()
 _PROFILED_BUCKETS: dict[tuple, int] = {}  # bucket key -> solves profiled
 
@@ -655,6 +695,49 @@ def _record_batch(size: int, waited_s: float, reports: list[dict]) -> None:
             )
 
 
+def _render_histogram(lines: list, name: str, label: str,
+                      snap: dict, help_text: str) -> None:
+    """One Prometheus histogram family from an ExemplarHistogram
+    snapshot: cumulative ``_bucket{le=}`` rows, ``_sum``/``_count``,
+    HELP/TYPE pair. Shared by kao_phase_seconds and kao_solve_seconds
+    so the exposition shape cannot drift between them."""
+    if not snap:
+        return
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} histogram")
+    for key in sorted(snap):
+        row = snap[key]
+        for le, n in row["buckets"]:
+            lines.append(
+                f'{name}_bucket{{{label}="{key}",le="{le}"}} {n}'
+            )
+        lines.append(
+            f'{name}_bucket{{{label}="{key}",le="+Inf"}} '
+            f'{row["count"]}'
+        )
+        lines.append(f'{name}_sum{{{label}="{key}"}} {row["sum"]}')
+        lines.append(f'{name}_count{{{label}="{key}"}} {row["count"]}')
+
+
+def _render_exemplars(lines: list, name: str, label: str,
+                      exemplars: list) -> None:
+    """The exemplar sidecar gauge family for one histogram: the worst
+    recent observation per (key, bucket) with its trace ID as a
+    label."""
+    if not exemplars:
+        return
+    lines.append(
+        f"# HELP {name} worst recent observation per ({label}, "
+        "bucket); trace_id resolves via /debug/solves"
+    )
+    lines.append(f"# TYPE {name} gauge")
+    for e in exemplars:
+        lines.append(
+            f'{name}{{{label}="{e[label]}",le="{e["le"]}",'
+            f'trace_id="{e["trace_id"]}"}} {e["value"]}'
+        )
+
+
 def render_metrics() -> str:
     # ONE atomic snapshot of everything behind _METRICS_LOCK: the
     # dispatchers mutate _METRICS and _BATCH_SIZES while this renders,
@@ -686,6 +769,19 @@ def render_metrics() -> str:
     # unless KAO_SANITIZE / --sanitize armed the guards
     for k, v in _sanitize_mod.snapshot().items():
         snap[f"sanitizer_{k}"] = v
+    # process uptime (satellite, ISSUE 9): rate() denominators and
+    # restart detection for every counter family above
+    snap["uptime_seconds"] = round(time.time() - _START_UNIX, 3)
+    # flight-recorder counters (obs.flight, docs/OBSERVABILITY.md)
+    for k, v in _oflight.snapshot().items():
+        if isinstance(v, (int, float)):
+            snap[f"flight_{k}"] = v
+    # solve-report ring occupancy: the /debug/solves payload bound in
+    # action (bytes resident + reports truncated to fit)
+    ring = _otrace.RECENT.stats()
+    snap["trace_ring_bytes"] = ring["bytes"]
+    snap["trace_ring_reports"] = ring["reports"]
+    snap["trace_ring_truncated_total"] = ring["truncated_total"]
     # --checkpoint-dir hygiene gauge (ISSUE 7 satellite): live .npz
     # count under the operator's checkpoint dir; the maintenance GC
     # (age + count caps) is what keeps this bounded
@@ -752,31 +848,83 @@ def render_metrics() -> str:
     # per-phase solve latency histograms, aggregated from solve traces
     # (obs.trace): which pipeline phase the wall-clock goes to, across
     # every traced solve this process has served
-    phases = _otrace.phase_snapshot()
-    if phases:
-        lines.append(
-            "# HELP kao_phase_seconds solve pipeline phase latency "
-            "(from solve traces)"
+    _render_histogram(
+        lines, "kao_phase_seconds", "phase", _otrace.phase_snapshot(),
+        "solve pipeline phase latency (from solve traces)",
+    )
+    # end-to-end solve latency histograms per record class (obs.flight):
+    # the SLO denominators — kao_phase_seconds says which PHASE ate a
+    # budget, kao_solve_seconds says which CLASS of traffic is slow
+    _render_histogram(
+        lines, "kao_solve_seconds", "class", _oflight.solve_snapshot(),
+        "end-to-end solve latency by record class (from flight "
+        "records)",
+    )
+    # exemplar linkage (docs/OBSERVABILITY.md): the worst recent
+    # observation per histogram bucket, its trace ID as a label — a
+    # spike on a bucket links DIRECTLY to GET /debug/solves/<id>
+    # (and ?format=chrome for the Perfetto flame chart). Rendered as
+    # sidecar gauge families: the classic text exposition has no
+    # native exemplar syntax, and a labeled gauge survives every
+    # Prometheus scraper while carrying the same linkage.
+    _render_exemplars(lines, "kao_solve_seconds_exemplar", "class",
+                      _oflight.solve_exemplars())
+    _render_exemplars(lines, "kao_phase_seconds_exemplar", "phase",
+                      _otrace.phase_exemplars())
+    # SLO engine (obs.slo): cumulative per-class counters + per-window
+    # burn-rate gauges. Families are emitted only when classes exist —
+    # the engine pre-declares the default classes, so they always do.
+    slo = _oslo.ENGINE.snapshot()
+    classes = slo.get("classes") or {}
+    if classes:
+        # table-driven per-class families (same factoring discipline
+        # as _render_histogram): one loop, one place to add the next
+        slo_families = (
+            ("kao_slo_events_total", "counter",
+             "flight records observed per SLO class",
+             lambda c: c["events_total"]),
+            ("kao_slo_latency_breaches_total", "counter",
+             "observations over the class latency objective",
+             lambda c: c["latency_breaches_total"]),
+            ("kao_slo_quality_breaches_total", "counter",
+             "infeasible/degraded plans per SLO class",
+             lambda c: c["quality_breaches_total"]),
+            ("kao_slo_latency_objective_seconds", "gauge",
+             "configured per-class latency objective",
+             lambda c: c["objective"]["latency_s"]),
+            ("kao_slo_target", "gauge",
+             "configured per-class success target",
+             lambda c: c["objective"]["target"]),
         )
-        lines.append("# TYPE kao_phase_seconds histogram")
-        for phase in sorted(phases):
-            row = phases[phase]
-            for le, n in row["buckets"]:
+        for name, kind, help_text, get in slo_families:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for cls in sorted(classes):
                 lines.append(
-                    f'kao_phase_seconds_bucket{{phase="{phase}",'
-                    f'le="{le}"}} {n}'
+                    f'{name}{{class="{cls}"}} {get(classes[cls])}'
                 )
-            lines.append(
-                f'kao_phase_seconds_bucket{{phase="{phase}",'
-                f'le="+Inf"}} {row["count"]}'
-            )
-            lines.append(
-                f'kao_phase_seconds_sum{{phase="{phase}"}} {row["sum"]}'
-            )
-            lines.append(
-                f'kao_phase_seconds_count{{phase="{phase}"}} '
-                f'{row["count"]}'
-            )
+        lines.append("# HELP kao_slo_burn_rate error-budget burn rate "
+                     "per class and window (>1 burns the budget)")
+        lines.append("# TYPE kao_slo_burn_rate gauge")
+        for cls in sorted(classes):
+            for win, w in sorted(classes[cls]["windows"].items()):
+                lines.append(
+                    f'kao_slo_burn_rate{{class="{cls}",'
+                    f'window="{win}"}} {w["burn_rate"]}'
+                )
+    # build identity (satellite, ISSUE 9): which code/runtime produced
+    # every number above — the first thing to check when two scrapes
+    # disagree
+    bi = _build_info()
+    lines.append("# HELP kao_build_info build/runtime identity "
+                 "(value is always 1; the labels carry the info)")
+    lines.append("# TYPE kao_build_info gauge")
+    lines.append(
+        'kao_build_info{'
+        f'version="{bi["version"]}",jax="{bi["jax"]}",'
+        f'backend="{bi["backend"]}",devices="{bi["devices"]}"'
+        "} 1"
+    )
     return "\n".join(lines) + "\n"
 
 
@@ -1459,11 +1607,17 @@ def _watch_solve_fn(state, prev_plan, budget) -> tuple[dict, dict]:
         tr = _otrace.begin(trace_id, name="watch_event",
                            cluster=state.cluster_id, epoch=state.epoch)
         try:
-            res = optimize_delta(
-                state.assignment, state.brokers, state.topology,
-                target_rf=state.rf, prev_plan=prev_plan,
-                solver=solver_eff, instance=inst, **kw,
-            )
+            # flight-record tagging on THIS worker thread: the watch
+            # manager's own context() does not cross the queue hop, so
+            # the delta identity is re-established where the engine
+            # actually runs (obs.flight, docs/OBSERVABILITY.md)
+            with _oflight.context("delta", cluster=state.cluster_id,
+                                  epoch=state.epoch):
+                res = optimize_delta(
+                    state.assignment, state.brokers, state.topology,
+                    target_rf=state.rf, prev_plan=prev_plan,
+                    solver=solver_eff, instance=inst, **kw,
+                )
         except BaseException as e:
             if tr is not None:
                 tr.root.set(error=repr(e)[:200])
@@ -1606,6 +1760,7 @@ def handle_healthz() -> dict:
     from .solvers.base import available_solvers
     from .solvers.tpu import bucket
 
+    _build_info(resolve=True)  # populate the /metrics build-info cache
     return {
         "status": "ok",
         "solvers": available_solvers(),
@@ -1627,8 +1782,14 @@ def handle_healthz() -> dict:
             "trace_enabled": bool(OBS["trace"]),
             "solve_reports_held": len(_otrace.RECENT.ids()),
             "report_ring_capacity": _otrace.RECENT.capacity,
+            "report_ring": _otrace.RECENT.stats(),
             "profile_dir": OBS["profile_dir"],
+            "flight": _oflight.snapshot(),
         },
+        # the SLO engine's verdict (obs.slo): worst status across
+        # classes + per-class burn rates — the one line a fleet
+        # health dashboard reads first (full detail: GET /debug/slo)
+        "slo": _healthz_slo(),
         "sanitizer": _sanitize_mod.snapshot(),
         "resilience": {
             "chaos": _chaos.snapshot(),
@@ -1642,6 +1803,26 @@ def handle_healthz() -> dict:
             "queue_wait_s": _SOLVES.queue_wait_s,
         },
         "watch": _healthz_watch(),
+    }
+
+
+def _healthz_slo() -> dict:
+    """The /healthz slo section: compact — status + per-class burn
+    rates, not the full event detail (that is GET /debug/slo)."""
+    snap = _oslo.ENGINE.snapshot()
+    return {
+        "status": snap.get("status", "ok"),
+        "classes": {
+            cls: {
+                "status": c["status"],
+                "events_total": c["events_total"],
+                "burn_rates": {
+                    win: w["burn_rate"]
+                    for win, w in c["windows"].items()
+                },
+            }
+            for cls, c in (snap.get("classes") or {}).items()
+        },
     }
 
 
@@ -1922,8 +2103,36 @@ class Handler(BaseHTTPRequestHandler):
                              f"(ring holds the last "
                              f"{_otrace.RECENT.capacity} traced solves)",
                 })
-            else:
+                return
+            # ?format=chrome: the span tree as Chrome trace-event JSON
+            # (obs.chrome) — save it and load in chrome://tracing or
+            # Perfetto; the offline path is `kao-trace convert`
+            from urllib.parse import parse_qs, urlparse
+
+            fmt = (parse_qs(urlparse(self.path).query)
+                   .get("format") or ["json"])[0]
+            if fmt == "chrome":
+                self._send(200, _ochrome.to_chrome(rep))
+            elif fmt == "json":
                 self._send(200, rep)
+            else:
+                self._send(400, {
+                    "error": f"unknown format {fmt!r}; "
+                             "want 'json' or 'chrome'",
+                })
+        elif route == "/debug/slo":
+            # the full SLO snapshot: per-class objectives, multi-window
+            # burn rates, worst-recent exemplars, and the tail of the
+            # flight-record stream (docs/OBSERVABILITY.md)
+            self._send(200, {
+                "slo": _oslo.ENGINE.snapshot(),
+                "flight": _oflight.snapshot(),
+                "exemplars": {
+                    "solve_seconds": _oflight.solve_exemplars(),
+                    "phase_seconds": _otrace.phase_exemplars(),
+                },
+                "recent_records": _oflight.recent(32),
+            })
         else:
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
@@ -2074,6 +2283,21 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="N",
                     help="profiled solves per bucket with "
                          "--profile-dir (default 1)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="solve-cost flight recorder "
+                         "(docs/OBSERVABILITY.md): append one compact "
+                         "JSONL record per solve/delta/batch-lane "
+                         "under this directory (crash-safe, "
+                         "auto-rotated); the SLO engine and "
+                         "kao_solve_seconds run off the same stream "
+                         "either way. Same as KAO_FLIGHT_DIR")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="per-class SLO objectives, e.g. "
+                         "'solve:5:0.99,delta:2:0.995' "
+                         "(class:latency_s[:target]); defaults in "
+                         "docs/OBSERVABILITY.md. Burn rates on "
+                         "/metrics (kao_slo_*), /healthz 'slo', and "
+                         "GET /debug/slo")
     ap.add_argument("--queue-wait-s", type=float,
                     default=DEFAULT_QUEUE_WAIT_S,
                     help="maintenance drain window: how long the "
@@ -2197,6 +2421,24 @@ def main(argv: list[str] | None = None) -> int:
     OBS["trace"] = not args.no_trace
     OBS["profile_dir"] = args.profile_dir
     OBS["profile_solves"] = args.profile_solves
+    import os
+
+    flight_dir = (args.flight_dir or os.environ.get("KAO_FLIGHT_DIR")
+                  or None)
+    if flight_dir:
+        # fail fast at boot like --watch-dir: an unwritable flight dir
+        # must be a clean startup error, not a per-solve warn loop
+        try:
+            _oflight.configure(flight_dir)
+        except OSError as e:
+            ap.error(f"--flight-dir {flight_dir!r}: {e}")
+    OBS["flight_dir"] = flight_dir
+    slo_spec = args.slo or os.environ.get("KAO_SLO")
+    if slo_spec:
+        try:
+            _oslo.ENGINE.configure(spec=slo_spec)
+        except ValueError as e:
+            ap.error(f"--slo/KAO_SLO: {e}")
     _SOLVES.configure(workers=args.workers, depth=args.queue_depth,
                       queue_wait_s=args.queue_wait_s)
     _COALESCER.configure(window_ms=args.batch_window_ms,

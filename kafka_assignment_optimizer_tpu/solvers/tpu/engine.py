@@ -28,6 +28,7 @@ import numpy as np
 from ...analysis import sanitize as _san
 from ...models import instance as _instance_mod
 from ...models.instance import ProblemInstance
+from ...obs import flight as _flight
 from ...obs import log as _olog
 from ...obs import trace as _otrace
 from ...resilience import chaos as _chaos
@@ -215,12 +216,42 @@ def solve_tpu(inst: ProblemInstance, *args,
     call: every rung any layer takes during this solve — mesh AOT
     fallbacks, Pallas→XLA retries, the chain-engine retry's own rungs —
     lands in ``stats["degradations"]`` exactly once, on the outermost
-    solve."""
-    with _ladder.collect() as _rungs:
-        res = _solve_tpu_traced(inst, *args, trace=trace, **kwargs)
-        if _rungs:
-            res.stats["degradations"] = list(_rungs)
-        return res
+    solve.
+
+    The flight recorder (obs.flight, docs/OBSERVABILITY.md) wraps it
+    the same way: the OUTERMOST solve lands one compact cost+quality
+    record — the accounting contextvar doubles as the nesting guard,
+    so a sweep→chain retry or a batch lane running inside another
+    recorded solve feeds the outer record instead of landing its own.
+    Precompile (warmup) solves are synthetic and never recorded."""
+    nested = _flight.accounting_active()
+    acc_tok = None if nested else _flight.start_accounting()
+    t0 = time.perf_counter()
+    try:
+        with _ladder.collect() as _rungs:
+            res = _solve_tpu_traced(inst, *args, trace=trace, **kwargs)
+            if _rungs:
+                res.stats["degradations"] = list(_rungs)
+    except BaseException as e:
+        acc = (
+            _flight.end_accounting(acc_tok) if acc_tok is not None
+            else None
+        )
+        if acc is not None and not kwargs.get("precompile"):
+            # a solve that RAISES must still burn the SLO quality
+            # budget — an outage of the solve path reading as zero
+            # burn would never page (docs/OBSERVABILITY.md)
+            _flight.record_failure(inst, acc,
+                                   time.perf_counter() - t0, e)
+        raise
+    acc = (
+        _flight.end_accounting(acc_tok) if acc_tok is not None
+        else None
+    )
+    if acc is not None and not kwargs.get("precompile"):
+        _flight.record_solve(res, inst, acc,
+                             wall_s=time.perf_counter() - t0)
+    return res
 
 
 def _solve_tpu_traced(inst: ProblemInstance, *args,
@@ -2051,14 +2082,48 @@ def solve_tpu_batch(*args, **kwargs) -> list[SolveResult]:
     dispatch apply to every lane, while a lane's own sequential
     fallback (collected lane-scoped inside the impl) lands on that
     lane's ``stats["degradations"]`` only — seven clean lanes must not
-    read as degraded because the eighth fell back."""
-    with _ladder.collect() as _rungs:
-        results = _solve_tpu_batch_impl(*args, **kwargs)
-        for r in results:
-            combined = list(_rungs or ()) + r.stats.get("degradations", [])
-            if combined:
-                r.stats["degradations"] = combined
-        return results
+    read as degraded because the eighth fell back.
+
+    Flight records: ONE record per lane, kind ``"lane"`` (obs.flight).
+    The accounting accumulator is shared by the whole dispatch, so a
+    lane record's compile/cache columns describe the batch's one
+    dispatch, not the lane alone; the per-lane quality columns are the
+    lane's own. The accumulator also suppresses the per-lane
+    ``solve_tpu`` records on the unstackable-fallback path — every
+    lane lands exactly one record either way."""
+    nested = _flight.accounting_active()
+    acc_tok = None if nested else _flight.start_accounting()
+    t0 = time.perf_counter()
+    insts = args[0] if args else kwargs.get("insts", ())
+    try:
+        with _ladder.collect() as _rungs:
+            results = _solve_tpu_batch_impl(*args, **kwargs)
+            for r in results:
+                combined = list(_rungs or ()) + r.stats.get(
+                    "degradations", [])
+                if combined:
+                    r.stats["degradations"] = combined
+    except BaseException as e:
+        acc = (
+            _flight.end_accounting(acc_tok) if acc_tok is not None
+            else None
+        )
+        if acc is not None:
+            # the whole batched dispatch failed: one failure record
+            # per lane, same accounting as the success path
+            for inst in insts:
+                _flight.record_failure(inst, acc,
+                                       time.perf_counter() - t0, e,
+                                       kind="lane")
+        raise
+    acc = (
+        _flight.end_accounting(acc_tok) if acc_tok is not None
+        else None
+    )
+    if acc is not None:
+        for inst, r in zip(insts, results):
+            _flight.record_solve(r, inst, acc, kind="lane")
+    return results
 
 
 def _solve_tpu_batch_impl(

@@ -41,6 +41,7 @@ import time
 from dataclasses import replace
 
 from ..models.cluster import Assignment
+from ..obs import flight as _oflight
 from ..obs import log as _olog
 from ..resilience.budget import Budget, backoff_s
 from .events import ClusterState, EventError, apply_event, valid_cluster_id
@@ -334,7 +335,15 @@ class WatchRegistry:
             prev_plan = (
                 Assignment.from_dict(c.plan) if c.plan else None
             )
-        plan_dict, report = self.solve_fn(target, prev_plan, budget)
+        # flight-record tagging (obs.flight): any engine solve the
+        # injected solve_fn runs on THIS thread lands as kind="delta"
+        # with the cluster/epoch identity — the CLI --events replay and
+        # bench's --replay-day get per-event flight records for free.
+        # (serve's solve_fn hops to a worker thread, where contextvars
+        # do not follow; it re-tags inside the worker job itself.)
+        with _oflight.context("delta", cluster=cluster_id,
+                              epoch=target.epoch):
+            plan_dict, report = self.solve_fn(target, prev_plan, budget)
         warm = bool(report.get("solver_warm_started")
                     or report.get("warm_started"))
         self._count(solves_total=1, warm_solves_total=int(warm))

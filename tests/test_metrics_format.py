@@ -128,6 +128,72 @@ def test_phase_histogram_is_cumulative_with_inf_terminal():
     assert vals[-1] == 1.0
 
 
+def test_build_info_and_uptime_on_metrics():
+    """ISSUE 9 satellite: the build-identity gauge and process uptime
+    are always present, well-formed, and carry the full label set."""
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    assert "kao_build_info" in names
+    assert "kao_uptime_seconds" in names
+    info = next(labels for n, labels in samples if n == "kao_build_info")
+    assert {k for k, _ in info} == {"version", "jax", "backend",
+                                   "devices"}
+    uptime = next(
+        float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+        if ln.startswith("kao_uptime_seconds ")
+    )
+    assert uptime >= 0.0
+
+
+def test_solve_seconds_histogram_and_exemplars_render():
+    """kao_solve_seconds{class=} + its exemplar sidecar family pass the
+    exposition validator and agree with the flight-record stream."""
+    from kafka_assignment_optimizer_tpu.obs import flight as oflight
+
+    # reset: an earlier test's solve may hold this bucket's exemplar
+    # (worst-recent wins), which would make this assertion order-fragile
+    oflight.reset_solve_stats()
+    oflight.observe_solve("solve", 0.7, trace_id="fmtprobe01")
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    assert {"kao_solve_seconds_bucket", "kao_solve_seconds_sum",
+            "kao_solve_seconds_count",
+            "kao_solve_seconds_exemplar"} <= names
+    assert any(
+        n == "kao_solve_seconds_exemplar"
+        and ("trace_id", "fmtprobe01") in labels
+        for n, labels in samples
+    )
+    # SLO families render with HELP/TYPE for every class
+    assert "kao_slo_burn_rate" in names
+    assert "kao_slo_events_total" in names
+
+
+def test_metrics_http_content_type():
+    """ISSUE 9 satellite: /metrics serves the Prometheus text
+    exposition content type (version 0.0.4) over real HTTP."""
+    import threading
+    import urllib.request
+
+    from kafka_assignment_optimizer_tpu.serve import make_server
+
+    s = make_server(port=0)
+    t = threading.Thread(target=s.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{s.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            ctype = resp.headers.get("Content-Type")
+            body = resp.read().decode()
+    finally:
+        s.shutdown()
+        s.server_close()
+    assert ctype == "text/plain; version=0.0.4"
+    validate_prometheus(body)
+
+
 def test_validator_rejects_malformed_exposition():
     import pytest
 

@@ -1,0 +1,771 @@
+"""The continuous-performance observatory (ISSUE 9,
+docs/OBSERVABILITY.md): flight recorder, SLO engine burn-rate math,
+Chrome trace export (golden-pinned), the noise-aware bench comparator,
+the byte-bounded report ring, and the end-to-end exemplar chain —
+metrics -> exemplar trace ID -> /debug/solves -> Chrome trace."""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.obs import chrome as ochrome
+from kafka_assignment_optimizer_tpu.obs import flight as oflight
+from kafka_assignment_optimizer_tpu.obs import regress as oregress
+from kafka_assignment_optimizer_tpu.obs import slo as oslo
+from kafka_assignment_optimizer_tpu.obs import trace as otrace
+
+GOLDEN = Path(__file__).parent / "golden" / "chrome_trace.json"
+REPO = Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+
+def test_flight_jsonl_rotation_and_roundtrip(tmp_path):
+    rec = oflight.FlightRecorder()
+    rec.configure(str(tmp_path), max_bytes=4096, max_files=2)
+    for i in range(200):
+        rec.write({"ts": i, "kind": "solve", "wall_s": 0.1,
+                   "pad": "x" * 80, "i": i})
+    snap = rec.snapshot()
+    assert snap["records_total"] == 200
+    assert snap["rotations_total"] >= 1
+    assert snap["write_errors_total"] == 0
+    # archives pruned to the cap; live file still present
+    archives = [p for p in tmp_path.iterdir()
+                if p.name.startswith("flight-")]
+    assert len(archives) <= 2
+    assert (tmp_path / "flight.jsonl").exists()
+    got = list(oflight.iter_records(str(tmp_path)))
+    # older records fell off with pruned archives, but the retained
+    # tail reads back in order and intact
+    assert got, "no records survived rotation"
+    idx = [r["i"] for r in got]
+    assert idx == sorted(idx)
+    assert idx[-1] == 199
+
+
+def test_flight_reader_tolerates_torn_tail(tmp_path):
+    rec = oflight.FlightRecorder()
+    rec.configure(str(tmp_path))
+    rec.write({"i": 1})
+    rec.write({"i": 2})
+    with open(tmp_path / "flight.jsonl", "a") as f:
+        f.write('{"i": 3, "tor')  # the kill -9 tail
+    got = list(oflight.iter_records(str(tmp_path / "flight.jsonl")))
+    assert [r["i"] for r in got] == [1, 2]
+
+
+def test_flight_write_failure_counts_never_raises(tmp_path):
+    rec = oflight.FlightRecorder()
+    rec.configure(str(tmp_path))
+    rec.write({"i": 1})
+    # yank the directory out from under the live handle
+    (tmp_path / "flight.jsonl").unlink()
+    tmp_path.rmdir()
+    rec._fh = None  # force a reopen attempt against the dead dir
+    rec.write({"i": 2})  # must not raise
+    assert rec.snapshot()["write_errors_total"] >= 1
+
+
+def test_solve_histogram_exemplar_worst_recent():
+    oflight.reset_solve_stats()
+    oflight.observe_solve("solve", 0.3, trace_id="small")
+    oflight.observe_solve("solve", 0.45, trace_id="big")
+    oflight.observe_solve("solve", 0.31, trace_id="later-small")
+    ex = {(e["class"], e["le"]): e for e in oflight.solve_exemplars()}
+    # 0.3/0.45/0.31 all land in the le=0.5 containment bucket; the
+    # WORST recent one owns the exemplar
+    assert ex[("solve", "0.5")]["trace_id"] == "big"
+    snap = oflight.solve_snapshot()["solve"]
+    assert snap["count"] == 3
+    # cumulative: every bucket >= 0.5 saw all three
+    assert dict(snap["buckets"])["0.5"] == 3
+
+
+# --------------------------------------------------------------------------
+# SLO engine: burn-rate window math at the boundaries
+# --------------------------------------------------------------------------
+
+
+def test_slo_window_boundary_and_burn_math():
+    eng = oslo.SLOEngine(objectives={
+        "solve": {"latency_s": 1.0, "target": 0.99},
+    })
+    # one breach + one ok inside the 5m window
+    eng.observe("solve", 10.0, True, trace_id="t-slow", now=1000.0)
+    eng.observe("solve", 0.1, True, trace_id="t-fast", now=1100.0)
+    s = eng.snapshot(now=1299.9)  # breach is 299.9s old: IN (age < 300)
+    w5 = s["classes"]["solve"]["windows"]["5m"]
+    assert w5["events"] == 2 and w5["latency_breaches"] == 1
+    # burn = (1 bad / 2 events) / (1 - 0.99) = 50
+    assert w5["burn_rate"] == pytest.approx(50.0)
+    assert s["classes"]["solve"]["status"] == "fast_burn"  # 1h burns too
+    # at age EXACTLY 300 the breach falls OUT of the 5m window
+    s2 = eng.snapshot(now=1300.0)
+    w5 = s2["classes"]["solve"]["windows"]["5m"]
+    assert w5["events"] == 1 and w5["latency_breaches"] == 0
+    assert w5["burn_rate"] == 0.0
+    # ...but stays in the 1h window until age 3600
+    assert s2["classes"]["solve"]["windows"]["1h"]["events"] == 2
+    s3 = eng.snapshot(now=1000.0 + 3600.0)
+    assert s3["classes"]["solve"]["windows"]["1h"]["events"] == 1
+    # cumulative counters never rewind
+    assert s3["classes"]["solve"]["events_total"] == 2
+    assert s3["classes"]["solve"]["latency_breaches_total"] == 1
+
+
+def test_slo_quality_breach_and_worst_exemplar():
+    eng = oslo.SLOEngine(objectives={
+        "delta": {"latency_s": 5.0, "target": 0.9},
+    })
+    eng.observe_record({"kind": "delta", "wall_s": 0.2, "ts": 100.0,
+                        "trace_id": "q1",
+                        "quality": {"feasible": False}})
+    eng.observe_record({"kind": "delta", "wall_s": 0.9, "ts": 101.0,
+                        "trace_id": "q2",
+                        "quality": {"feasible": True}})
+    s = eng.snapshot(now=102.0)
+    c = s["classes"]["delta"]
+    assert c["quality_breaches_total"] == 1
+    assert c["windows"]["5m"]["quality_breaches"] == 1
+    # burn = (1/2) / 0.1 = 5 on both windows -> fast burn
+    assert c["windows"]["5m"]["burn_rate"] == pytest.approx(5.0)
+    assert c["status"] == "fast_burn"
+    # worst recent observation carries ITS trace id (the 0.9 s one)
+    assert c["worst_recent"]["trace_id"] == "q2"
+
+
+def test_slo_worst_recent_expires_at_read_time():
+    """A quiet class must not advertise a trace the report ring
+    evicted: worst_recent drops out of snapshots past the longest
+    window, same read-time rule as the histogram exemplars."""
+    eng = oslo.SLOEngine()
+    eng.observe("solve", 3.0, True, trace_id="w", now=0.0)
+    assert eng.snapshot(now=100.0)["classes"]["solve"][
+        "worst_recent"]["trace_id"] == "w"
+    assert "worst_recent" not in eng.snapshot(
+        now=3601.0)["classes"]["solve"]
+
+
+def test_slo_spec_parser_is_loud():
+    ok = oslo.parse_spec("solve:5:0.99,delta:2")
+    assert ok["solve"] == {"latency_s": 5.0, "target": 0.99}
+    assert ok["delta"]["target"] == 0.99  # default
+    for bad in ("solve", "solve:0:0.9", "solve:5:1.5", "solve:x",
+                "bad name:5", ""):
+        with pytest.raises(ValueError):
+            oslo.parse_spec(bad)
+
+
+# --------------------------------------------------------------------------
+# Chrome trace export (golden-pinned)
+# --------------------------------------------------------------------------
+
+_CHROME_REPORT = {
+    "trace_id": "deadbeef00000001",
+    "name": "request",
+    "started_unix": 1754300000.0,
+    "wall_s": 1.25,
+    "phases": {"bounds": 0.4, "ladder": 0.8},
+    "annealing": {"engine": "sweep", "rounds": 8},
+    "spans": {
+        "name": "request", "start_s": 0.0, "wall_s": 1.25,
+        "attrs": {"solver": "tpu", "feasible": True},
+        "spans": [
+            {"name": "bounds", "start_s": 0.01, "wall_s": 0.4},
+            {"name": "constructor", "start_s": 0.02, "wall_s": None},
+            {"name": "seed", "start_s": 0.42, "wall_s": 0.01},
+            {"name": "ladder", "start_s": 0.43, "wall_s": 0.8,
+             "attrs": {"engine": "sweep", "pipelined": True},
+             "spans": [
+                 {"name": "chunk", "start_s": 0.44, "wall_s": 0.3,
+                  "attrs": {"index": 0},
+                  "spans": [{"name": "compile", "start_s": 0.45,
+                             "wall_s": 0.2},
+                            {"name": "dispatch", "start_s": 0.66,
+                             "wall_s": 0.05,
+                             "attrs": {"cache": "miss"}}]},
+                 {"name": "chunk", "start_s": 0.75, "wall_s": 0.4,
+                  "attrs": {"index": 1}},
+                 {"name": "degrade", "start_s": 0.8, "wall_s": 0,
+                  "attrs": {"rung": "pallas_to_xla"}},
+             ]},
+            {"name": "polish", "start_s": 1.23, "wall_s": 0,
+             "attrs": {"skipped": True}},
+            {"name": "verify", "start_s": 1.24, "wall_s": 0.01},
+        ],
+    },
+}
+
+
+def test_chrome_export_matches_golden():
+    got = ochrome.to_chrome(_CHROME_REPORT)
+    want = json.loads(GOLDEN.read_text())
+    assert got == want, (
+        "Chrome export drifted from tests/golden/chrome_trace.json — "
+        "if the change is intentional, regenerate the golden file"
+    )
+
+
+def test_chrome_export_invariants():
+    out = ochrome.to_chrome(_CHROME_REPORT)
+    evs = [e for e in out["traceEvents"] if e["ph"] != "M"]
+    # stable field set per phase kind
+    for e in evs:
+        base = {"name", "ph", "ts", "pid", "tid", "cat"}
+        assert base <= set(e), e
+        if e["ph"] == "X":
+            assert "dur" in e and e["dur"] >= 0
+        else:
+            assert e["ph"] == "i" and e["s"] == "t" and "dur" not in e
+    # monotonic ts
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # parent/child nesting preserved: every event's interval sits
+    # inside the root's, and same-tid X events properly nest (no
+    # partial overlap)
+    root = evs[0]
+    assert root["name"] == "request" and root["tid"] == 0
+    for e in evs[1:]:
+        assert e["ts"] >= root["ts"]
+        assert e["ts"] + e.get("dur", 0) <= root["ts"] + root["dur"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    for i, a in enumerate(xs):
+        for b in xs[i + 1:]:
+            if a["tid"] != b["tid"]:
+                continue
+            a0, a1 = a["ts"], a["ts"] + a["dur"]
+            b0, b1 = b["ts"], b["ts"] + b["dur"]
+            overlap = max(a0, b0) < min(a1, b1)
+            nested = (a0 <= b0 and b1 <= a1) or (b0 <= a0 and a1 <= b1)
+            assert not overlap or nested, (a, b)
+    # the in-flight worker span landed off the main lane, flagged
+    cons = next(e for e in evs if e["name"] == "constructor")
+    assert cons["tid"] != 0 and cons["args"]["in_flight"] is True
+    # root carries the trace id
+    assert evs[0]["args"]["trace_id"] == "deadbeef00000001"
+
+
+def test_kao_trace_convert_cli(tmp_path):
+    from kafka_assignment_optimizer_tpu.obs.trace_cli import main
+
+    rep = tmp_path / "report.json"
+    # the CLI --trace wrapper shape: solve_report nested in the report
+    rep.write_text(json.dumps({"feasible": True,
+                               "solve_report": _CHROME_REPORT}))
+    out = tmp_path / "chrome.json"
+    assert main(["convert", str(rep), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc == ochrome.to_chrome(_CHROME_REPORT)
+    # a non-report file errors cleanly
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"no": "spans"}')
+    assert main(["convert", str(bad)]) == 2
+
+
+def test_kao_trace_flight_cli(tmp_path, capsys):
+    from kafka_assignment_optimizer_tpu.obs.trace_cli import main
+
+    f = tmp_path / "flight.jsonl"
+    f.write_text('{"kind": "solve", "i": 1}\n'
+                 '{"kind": "delta", "i": 2}\n'
+                 '{"torn')
+    assert main(["flight", str(f), "--kind", "delta"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1 and json.loads(out[0])["i"] == 2
+
+
+# --------------------------------------------------------------------------
+# byte-bounded solve-report ring (ISSUE 9 satellite)
+# --------------------------------------------------------------------------
+
+
+def _deep_report(tid: str, depth: int, fanout: int) -> dict:
+    def span(d):
+        s = {"name": f"lvl{d}", "start_s": 0.0, "wall_s": 1.0,
+             "attrs": {"pad": "x" * 40}}
+        if d < depth:
+            s["spans"] = [span(d + 1) for _ in range(fanout)]
+        return s
+
+    return {"trace_id": tid, "name": "solve", "started_unix": 0.0,
+            "wall_s": 1.0, "phases": {}, "spans": span(0)}
+
+
+def test_report_ring_truncates_deepest_first():
+    ring = otrace.ReportRing(capacity=8, max_report_bytes=4096,
+                             max_total_bytes=64 << 10)
+    ring.put(_deep_report("big1", depth=6, fanout=4))
+    rep = ring.get("big1")
+    assert rep["truncated"] is True
+    assert len(json.dumps(rep)) <= 4096
+
+    def depth_of(s):
+        return 1 + max((depth_of(c) for c in s.get("spans") or ()),
+                       default=0)
+
+    def dropped(s):
+        return (s.get("spans_dropped", 0)
+                + sum(dropped(c) for c in s.get("spans") or ()))
+
+    # the shallow skeleton survives; the deepest levels were pruned
+    # and accounted for
+    assert depth_of(rep["spans"]) < 7
+    assert dropped(rep["spans"]) > 0
+    assert ring.stats()["truncated_total"] == 1
+
+
+def test_report_ring_bounds_total_bytes():
+    ring = otrace.ReportRing(capacity=100, max_report_bytes=8 << 10,
+                             max_total_bytes=20 << 10)
+    for i in range(10):
+        ring.put(_deep_report(f"r{i}", depth=4, fanout=3))
+    st = ring.stats()
+    assert st["bytes"] <= 20 << 10
+    assert st["reports"] < 10  # oldest evicted on byte pressure
+    ids = ring.ids()
+    assert ids[0] == "r9"  # newest always retained
+    assert ring.get("r0") is None
+
+
+def test_small_reports_pass_through_untouched():
+    ring = otrace.ReportRing(capacity=4)
+    rep = {"trace_id": "t", "spans": {"name": "s", "start_s": 0.0,
+                                      "wall_s": 0.1}}
+    ring.put(rep)
+    assert "truncated" not in ring.get("t")
+    # untruncated puts store the SAME object (no copy cost)
+    assert ring.get("t") is rep
+
+
+# --------------------------------------------------------------------------
+# noise-aware perf-regression gate (obs/regress.py)
+# --------------------------------------------------------------------------
+
+
+def _artifact(**over) -> dict:
+    import bench as bench_mod
+
+    art = {
+        "metric": "decommission_255b_10000p_warm_wall_clock",
+        "value": 1.0, "unit": "s", "vs_baseline": 5.0,
+        "platform": "cpu", "cold_wall_clock_s": 2.0,
+        "cold_cached_wall_clock_s": 1.8,
+        "moves": 117, "min_moves_lb": 117, "feasible": True,
+        "proved_optimal": True, "engine": "construct",
+        "env": {"git_sha": "aaaa000000", "platform": "cpu",
+                "devices": 8, "xla_flags": ""},
+        "rows_schema": bench_mod.ROW_SCHEMA,
+        "scenarios": [
+            ["decommission", 1.0, 2.0, 117, 117, 1, 1, 1, "construct",
+             "agg", 1.0, 1, 2, [0.1, 0, 0, 0.5, 0, 0.1], None],
+            ["adversarial", 3.6, 26.0, 117, 117, 1, 1, 0, "sweep", "",
+             22.4, 2, 4, [0.1, 0, 0, 3.0, 0, 0.1], 1.2],
+        ],
+        "jumbo_cold_runs": [10.0, 11.0, 9.0],
+        "search_cold_runs": {"adversarial": [26.0, 7.0, 7.1]},
+        "replay_day": {"warm_p50_s": 0.1, "warm_p99_s": 0.4,
+                       "cold_p50_s": 0.2, "cold_p99_s": 0.8,
+                       "quality_ok": True, "storm_dropped": 0},
+        "batch_throughput": {"b1": 1.0, "b2": 1.8, "b4": 3.0,
+                             "b8": 5.0, "lanes_feasible": True,
+                             "moves_at_bound": True},
+    }
+    art.update(over)
+    return art
+
+
+def test_regress_identical_self_compare_is_ok():
+    art = _artifact()
+    v = oregress.compare(art, json.loads(json.dumps(art)))
+    assert v["comparable"] and v["verdict"] == "ok"
+    assert not v["latency"]["confirmed"] and not v["latency"]["suspect"]
+    assert not v["quality_regressions"]
+    assert v["checked"] > 5
+
+
+def test_regress_flags_seeded_2x_slowdown():
+    art = _artifact()
+    slow = oregress.seed_slowdown(art, 2.0)
+    # quality untouched by the fixture
+    assert slow["feasible"] is True
+    assert slow["scenarios"][0][3] == art["scenarios"][0][3]  # moves
+    v = oregress.compare(art, slow)
+    assert v["verdict"] == "regression", v
+    # every latency metric doubled: a full suspect quorum (2.0 < hard)
+    assert len(v["latency"]["suspect"]) >= v["suspect_quorum"]
+    # and the reverse direction reads as an improvement
+    v2 = oregress.compare(slow, art)
+    assert v2["verdict"] == "ok" and v2["latency"]["improved"]
+
+
+def test_regress_single_metric_jitter_does_not_trip():
+    art = _artifact()
+    noisy = json.loads(json.dumps(art))
+    noisy["scenarios"][1][1] = 6.5  # adversarial warm 3.6 -> 1.8x
+    v = oregress.compare(art, noisy)
+    assert v["verdict"] == "ok"
+    assert len(v["latency"]["suspect"]) == 1
+    # but a single CONFIRMED (>hard_ratio) metric trips alone
+    noisy["scenarios"][1][1] = 10.0  # 2.8x
+    v = oregress.compare(art, noisy)
+    assert v["verdict"] == "regression"
+    assert v["latency"]["confirmed"]
+
+
+def test_regress_headline_not_double_counted_with_rows():
+    """With scenario rows present, the top-level headline fields are
+    the headline row's numbers verbatim — they must not enter the
+    check set twice (one jittery draw would fill the suspect quorum
+    by itself)."""
+    art = _artifact()
+    names = [n for n, _, _ in oregress._latency_pairs(art, art)]
+    assert "headline_warm_s" not in names
+    assert "decommission.warm_s" in names
+    # headline-only artifacts still use the top-level fields
+    bare = {k: v for k, v in art.items()
+            if k not in ("scenarios", "rows_schema")}
+    names = [n for n, _, _ in oregress._latency_pairs(bare, bare)]
+    assert "headline_warm_s" in names
+
+
+def test_regress_quality_regression_is_noise_free():
+    art = _artifact()
+    bad = json.loads(json.dumps(art))
+    bad["scenarios"][1][5] = 0  # adversarial feasible 1 -> 0
+    v = oregress.compare(art, bad)
+    assert v["verdict"] == "regression"
+    assert any("feasible" in r["metric"]
+               for r in v["quality_regressions"])
+    # moves past a previously-met tight bound is also quality
+    bad2 = json.loads(json.dumps(art))
+    bad2["scenarios"][1][3] = 140  # moves 117 -> 140 past lb 117
+    v2 = oregress.compare(art, bad2)
+    assert any("moves_vs_bound" in r["metric"]
+               for r in v2["quality_regressions"])
+
+
+def test_regress_refuses_incomparable_environments():
+    art = _artifact()
+    other = _artifact()
+    other["env"]["devices"] = 1
+    v = oregress.compare(art, other)
+    assert v["verdict"] == "incomparable" and not v["comparable"]
+    # --force overrides
+    v2 = oregress.compare(art, other, force=True)
+    assert v2["comparable"]
+    # unstamped artifacts refuse too (old BENCH_r0x files)
+    unstamped = _artifact()
+    del unstamped["env"]
+    assert oregress.compare(art, unstamped)["verdict"] == "incomparable"
+
+
+def test_regress_sub_floor_baseline_blowup_is_caught():
+    """The noise floor gates on the LARGER side of a pair: a 15 ms
+    warm-certify baseline degrading to seconds must stay visible even
+    though 15 ms alone sits under the floor."""
+    art = _artifact()
+    art["replay_day"]["warm_p50_s"] = 0.015
+    blow = json.loads(json.dumps(art))
+    blow["replay_day"]["warm_p50_s"] = 3.0
+    v = oregress.compare(art, blow)
+    assert v["verdict"] == "regression"
+    assert any(r["metric"] == "replay_day.warm_p50_s"
+               for r in v["latency"]["confirmed"])
+    # tiny-vs-tiny stays ignored (both under the floor)
+    quiet = json.loads(json.dumps(art))
+    quiet["replay_day"]["warm_p50_s"] = 0.019
+    v2 = oregress.compare(art, quiet)
+    names = [r["metric"] for r in v2["latency"]["suspect"]
+             + v2["latency"]["confirmed"]]
+    assert "replay_day.warm_p50_s" not in names
+
+
+def test_exemplar_ttl_drops_stale_links_at_read_time():
+    """An exemplar past the TTL is dropped from snapshots entirely — a
+    quiet bucket must not advertise a trace the report ring evicted."""
+    import time as _time
+
+    h = otrace.ExemplarHistogram((1.0,), ttl_s=0.05)
+    h.observe("solve", 2.0, trace_id="stale-soon")
+    assert h.exemplars("class")
+    _time.sleep(0.08)
+    assert h.exemplars("class") == []
+    # the histogram counts themselves never expire
+    assert h.snapshot()["solve"]["count"] == 1
+
+
+def test_regress_refuses_errored_and_empty_artifacts():
+    """A bench run that failed outright (or artifacts sharing no
+    metrics) must read as incomparable, never as a green gate."""
+    art = _artifact()
+    errored = {"metric": "replay_day", "error": "backend init blew up",
+               "env": dict(art["env"])}
+    v = oregress.compare(art, errored)
+    assert v["verdict"] == "incomparable"
+    assert "bench failure" in v["reason"]
+    bare = {"metric": "x", "env": dict(art["env"])}
+    v2 = oregress.compare(bare, bare)
+    assert v2["verdict"] == "incomparable"
+    assert "no comparable metrics" in v2["reason"]
+
+
+def test_regress_median_of_n_resists_one_outlier():
+    art = _artifact()
+    noisy = json.loads(json.dumps(art))
+    # one wild cold draw; the median barely moves
+    noisy["jumbo_cold_runs"] = [10.0, 30.0, 9.0]
+    v = oregress.compare(art, noisy)
+    names = [r["metric"] for r in
+             v["latency"]["suspect"] + v["latency"]["confirmed"]]
+    assert "jumbo_cold_median_s" not in names
+
+
+def test_bench_compare_cli_wiring(tmp_path):
+    """bench.py --compare prints the verdict JSON first and returns
+    the gate exit code (0 ok / 3 regression) — the CI contract."""
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_artifact()))
+    b.write_text(json.dumps(oregress.seed_slowdown(_artifact(), 2.0)))
+    ok = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare",
+         str(a), str(a)],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout)["verdict"] == "ok"
+    trip = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare",
+         str(a), str(b)],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert trip.returncode == 3, (trip.stdout, trip.stderr)
+    assert json.loads(trip.stdout)["verdict"] == "regression"
+
+
+# --------------------------------------------------------------------------
+# engine + watch integration: records per solve/delta
+# --------------------------------------------------------------------------
+
+
+def _demo_instance():
+    from kafka_assignment_optimizer_tpu import build_instance
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        demo_assignment, demo_broker_list, demo_topology,
+    )
+
+    return build_instance(demo_assignment(), demo_broker_list(),
+                          demo_topology())
+
+
+def test_engine_solve_lands_one_flight_record(tmp_path):
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        solve_tpu,
+    )
+
+    oflight.configure(str(tmp_path))
+    oflight.reset_recent()
+    try:
+        res = solve_tpu(_demo_instance(), seed=0, batch=4, rounds=4,
+                        steps_per_round=40, trace=True)
+    finally:
+        oflight.configure(None)
+    recs = oflight.recent(kind="solve")
+    # exactly ONE record: the sweep->chain retry and any nested solve
+    # feed the outer record instead of landing their own
+    assert len(recs) == 1, [r["kind"] for r in oflight.recent()]
+    rec = recs[0]
+    assert rec["trace_id"] == res.stats["trace_id"]
+    assert rec["quality"]["feasible"] is True
+    assert rec["quality"]["moves"] == res.stats["moves"]
+    assert set(rec["split"]) == {"compile_s", "device_s", "dispatch_s",
+                                "host_s"}
+    assert "bounds" in rec["phases"] and "ladder" in rec["phases"]
+    assert rec["bucket"][0] == 19  # demo brokers
+    # the record also hit the durable JSONL
+    disk = list(oflight.iter_records(str(tmp_path)))
+    assert [r["trace_id"] for r in disk] == [rec["trace_id"]]
+    # and the solve-seconds histogram + SLO engine saw it
+    assert oflight.solve_snapshot()["solve"]["count"] >= 1
+
+
+def test_failed_solve_lands_failure_record(monkeypatch):
+    """A solve that RAISES must still burn the SLO quality budget —
+    a total outage of the solve path must not read as zero burn."""
+    import kafka_assignment_optimizer_tpu.solvers.tpu.engine as eng
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic solve failure")
+
+    monkeypatch.setattr(eng, "_solve_tpu_traced", boom)
+    oflight.reset_recent()
+    with pytest.raises(RuntimeError):
+        eng.solve_tpu(_demo_instance(), seed=0)
+    recs = oflight.recent(kind="solve")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["quality"]["feasible"] is False
+    assert "synthetic solve failure" in rec["error"]
+    assert rec["bucket"][0] == 19
+    # the SLO engine counted it as a quality breach
+    eng2 = oslo.SLOEngine()
+    eng2.observe_record(rec)
+    s = eng2.snapshot(now=rec["ts"] + 1)
+    assert s["classes"]["solve"]["quality_breaches_total"] == 1
+
+
+def test_exact_solver_optimize_lands_reduced_record():
+    """Small instances route 'auto' to the exact oracles, which have
+    no engine-level recorder — api.optimize lands the reduced record
+    so exact-solver traffic is not an SLO blind spot."""
+    from kafka_assignment_optimizer_tpu.api import optimize
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        demo_assignment, demo_broker_list, demo_topology,
+    )
+
+    oflight.reset_recent()
+    optimize(demo_assignment(), demo_broker_list(), demo_topology(),
+             solver="milp")
+    recs = oflight.recent(kind="solve")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["engine"] == "milp"
+    assert rec["quality"]["feasible"] is True
+    assert rec["quality"]["certified"] is True
+    assert rec["quality"]["moves"] == 1  # the golden demo answer
+    assert rec["warm"]["warm_path"] is True  # exact solvers never compile
+
+
+def test_watch_delta_events_each_land_a_flight_record():
+    """Acceptance (ISSUE 9): replayed watch events each produce one
+    kind="delta" flight record carrying the cluster/epoch identity —
+    the same ambient tagging bench.py --replay-day rides."""
+    from kafka_assignment_optimizer_tpu.api import optimize_delta
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        demo_assignment, demo_topology,
+    )
+    from kafka_assignment_optimizer_tpu.watch.manager import (
+        WatchRegistry,
+    )
+
+    def solve_fn(state, prev_plan, budget):
+        res = optimize_delta(
+            state.assignment, state.brokers, state.topology,
+            target_rf=state.rf, prev_plan=prev_plan, solver="tpu",
+            seed=0, batch=4, rounds=4, steps_per_round=40,
+        )
+        return res.assignment.to_dict(), res.report()
+
+    reg = WatchRegistry(solve_fn, None, window_s=0.0)
+    oflight.reset_recent()
+    topo = demo_topology()
+    reg.handle_event("obs-e2e", {
+        "type": "bootstrap", "epoch": 1,
+        "assignment": demo_assignment().to_dict(),
+        "brokers": list(range(19)), "topology": topo.to_dict(),
+    })
+    reg.handle_event("obs-e2e", {
+        "type": "broker_drain", "epoch": 2, "brokers": [18],
+    })
+    recs = oflight.recent(kind="delta")
+    assert len(recs) == 2
+    assert [r["epoch"] for r in recs] == [1, 2]
+    assert all(r["cluster"] == "obs-e2e" for r in recs)
+    # the drain delta warm-started from the bootstrap plan
+    assert recs[1]["warm"]["warm_started"] is True
+
+
+# --------------------------------------------------------------------------
+# end-to-end exemplar chain over real HTTP (ISSUE 9 acceptance)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def obs_server():
+    from kafka_assignment_optimizer_tpu.serve import make_server
+
+    srv = make_server(port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_exemplar_chain_metrics_to_chrome_trace(obs_server):
+    """The p99-spike walkthrough, mechanised: solve -> scrape the
+    kao_solve_seconds exemplar -> its trace ID resolves on
+    /debug/solves/<id> -> ?format=chrome exports a valid trace whose
+    root carries the same ID -> /debug/slo saw the record."""
+    from kafka_assignment_optimizer_tpu.models.cluster import (
+        demo_assignment,
+    )
+
+    oflight.reset_solve_stats()
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        "solver": "tpu",
+        "options": {"seed": 0, "batch": 4, "rounds": 4,
+                    "steps_per_round": 40},
+    }
+    req = urllib.request.Request(
+        obs_server + "/submit", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        body = json.loads(r.read())
+    tid = body["trace_id"]
+
+    with urllib.request.urlopen(obs_server + "/metrics",
+                                timeout=30) as r:
+        metrics = r.read().decode()
+    ex_lines = [ln for ln in metrics.splitlines()
+                if ln.startswith("kao_solve_seconds_exemplar{")]
+    assert ex_lines, metrics[-2000:]
+    line = next(ln for ln in ex_lines if 'class="solve"' in ln)
+    ex_tid = line.split('trace_id="', 1)[1].split('"', 1)[0]
+    assert ex_tid == tid  # the only solve since reset IS the worst
+
+    with urllib.request.urlopen(
+        f"{obs_server}/debug/solves/{ex_tid}", timeout=30
+    ) as r:
+        rep = json.loads(r.read())
+    assert rep["trace_id"] == ex_tid and "spans" in rep
+
+    with urllib.request.urlopen(
+        f"{obs_server}/debug/solves/{ex_tid}?format=chrome", timeout=30
+    ) as r:
+        ct = json.loads(r.read())
+    evs = [e for e in ct["traceEvents"] if e["ph"] != "M"]
+    assert evs and evs[0]["args"]["trace_id"] == ex_tid
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    assert ct["otherData"]["trace_id"] == ex_tid
+
+    with urllib.request.urlopen(obs_server + "/debug/slo",
+                                timeout=30) as r:
+        slo = json.loads(r.read())
+    assert slo["slo"]["classes"]["solve"]["events_total"] >= 1
+    assert any(rec.get("trace_id") == tid
+               for rec in slo["recent_records"])
+
+    # /healthz carries the compact slo section
+    with urllib.request.urlopen(obs_server + "/healthz",
+                                timeout=30) as r:
+        hz = json.loads(r.read())
+    assert "slo" in hz and "status" in hz["slo"]
+    # no --flight-dir on this server: the recorder is disabled, but
+    # the record STREAM (ring + SLO + histograms) saw the solve
+    assert hz["observability"]["flight"]["stream_records_total"] >= 1
+    assert hz["observability"]["flight"]["enabled"] == 0
