@@ -55,6 +55,24 @@ BASELINE_BUDGET_S = 5.0  # north-star (BASELINE.json)
 PHASE_ORDER = ("bounds", "constructor", "seed", "ladder", "polish",
                "verify")
 
+# constructor sub-phases (ISSUE 10, docs/CONSTRUCTOR.md): their summed
+# seconds are the scenario row's construct_host_s column — the host
+# time actually spent in the flow bounds / greedy / reseat / adoption
+# loops the vectorized constructor rewrote, as opposed to the
+# constructor PHASE span, which is mostly overlap-wait
+SUB_PHASES = ("bounds_flow", "greedy", "reseat", "adopt")
+
+
+def _median(xs) -> float | None:
+    """Rounded median, delegating to the ONE median implementation the
+    comparator uses (obs/regress.py) so the stamped artifact medians
+    can never diverge from the values ``--compare`` recomputes.
+    Import is lazy and parent-safe: regress touches no jax."""
+    from kafka_assignment_optimizer_tpu.obs.regress import _median as _m
+
+    v = _m(xs or ())
+    return None if v is None else round(v, 4)
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -264,6 +282,14 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         for k, v in (trace_rep.get("phases") or {}).items()
         if k in PHASE_ORDER
     }
+    # constructor host time (ISSUE 10): the summed sub-phase seconds
+    # the solve report rolls up (obs.trace.SUB_PHASES) — flow bounds +
+    # greedy + exact reseat + plan adoption, wherever they ran (race
+    # workers included)
+    construct_host_s = round(sum(
+        v for k, v in (trace_rep.get("phases") or {}).items()
+        if k in SUB_PHASES
+    ), 4)
 
     # same-bucket reuse probe (warm search rows only): a DIFFERENT
     # cluster — a few partitions dropped, same bucket — must reuse the
@@ -366,6 +392,9 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         # telemetry): localizes a wall-clock regression to bounds /
         # constructor / seed / ladder / polish / verify
         "phase_s": phase_s,
+        # summed constructor sub-phase host seconds (bounds_flow +
+        # greedy + reseat + adopt — docs/CONSTRUCTOR.md)
+        "construct_host_s": construct_host_s,
         # pipeline-on/off A/B on the warm search rows (null elsewhere)
         "pipeline_speedup": pipeline_speedup,
         "pipeline": res.solve.stats.get("pipeline"),
@@ -798,7 +827,7 @@ ROW_SCHEMA = ("scenario,warm_s,cold_s,moves,min_moves_lb,feasible,"
               "proved_optimal,constructed,engine,path,compile_s,"
               "cache_compiles,cache_hits,"
               "phase_s[bounds,constructor,seed,ladder,polish,verify],"
-              "pipeline_speedup")
+              "pipeline_speedup,construct_host_s")
 
 
 def _compact_row(r: dict | None, name: str, err: str | None) -> list:
@@ -806,7 +835,8 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
     every README results-table row from the artifact alone."""
     if r is None:
         return [name, None, None, None, None, 0, 0, 0, "error",
-                (err or "failed")[:80], None, None, None, None, None]
+                (err or "failed")[:80], None, None, None, None, None,
+                None]
     cache = r.get("cache") or {}
     ph = r.get("phase_s") or {}
     return [
@@ -829,6 +859,9 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
         # best-warm / pipelined best-warm — >= 1.0 means the overlap
         # pays for itself in wall-clock
         r.get("pipeline_speedup"),
+        # constructor host seconds: bounds_flow + greedy + reseat +
+        # adopt summed from the solve report (ISSUE 10)
+        r.get("construct_host_s"),
     ]
 
 
@@ -892,7 +925,9 @@ def _print_final(line: dict) -> None:
     overflow the driver's tail capture. Never raises."""
     for drop in ((), ("search_cold_runs",), ("jumbo_cold_runs",),
                  ("kernel",), ("bucket_reuse",), ("replay_day",),
-                 ("batch_throughput",), ("scenarios", "rows_schema")):
+                 ("batch_throughput",),
+                 ("search_cold_medians", "jumbo_cold_median_s"),
+                 ("scenarios", "rows_schema")):
         for key in drop:
             line.pop(key, None)
         s = json.dumps(line)
@@ -978,13 +1013,21 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         line["scenarios"] = scenarios
     if jumbo_runs:
         # repeated fresh-process jumbo solves: the variance-discipline
-        # evidence (VERDICT r3 item 3 — bounded time AND spread)
+        # evidence (VERDICT r3 item 3 — bounded time AND spread), with
+        # the MEDIAN alongside (ISSUE 10): the 7.4-13.1 s spread of
+        # BENCH_r05 made the headline first-run draw the artifact
+        # value — the median is the stable statistic readers should
+        # quote, and obs/regress.py already compares on it
         line["jumbo_cold_runs"] = jumbo_runs
+        line["jumbo_cold_median_s"] = _median(jumbo_runs)
     if search_cold_runs:
         # sweep-path cold starts, 3 fresh processes each (run 0 =
         # empty compile cache; later runs pay the cache-warm cold every
         # subsequent process on this host sees — VERDICT r4 item 2)
         line["search_cold_runs"] = search_cold_runs
+        line["search_cold_medians"] = {
+            k: _median(v) for k, v in search_cold_runs.items()
+        }
     if bucket_reuse:
         # a DIFFERENT cluster mapping to an already-compiled bucket:
         # compiles == 0 / cache_hit true is the shape-bucketing
@@ -1014,6 +1057,14 @@ def main() -> int:
     ap.add_argument("--headline-only", action="store_true",
                     help="run only the headline scenario")
     ap.add_argument("--smoke", action="store_true", help="tiny instances")
+    ap.add_argument("--only", default=None, metavar="S1,S2,...",
+                    help="run ONLY the named scenarios, cold, skipping "
+                         "every extra (kernel, batch throughput, "
+                         "replay day, repeated cold runs). The first "
+                         "name is the headline. Built for the CI "
+                         "cold-path step: the lp/construct-dominated "
+                         "scenarios twice, then bench.py --compare "
+                         "(docs/CONSTRUCTOR.md)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kernel", action="store_true",
                     help="also time Pallas kernel vs XLA scorer "
@@ -1090,14 +1141,35 @@ def main() -> int:
     print(f"[bench] platform={platform}"
           + (f" (accelerator unavailable: {tpu_err})" if tpu_err else ""),
           file=sys.stderr)
+    only_names: list[str] | None = None
+    if args.only:
+        from kafka_assignment_optimizer_tpu.utils import gen
+
+        only_names = [s.strip() for s in args.only.split(",") if s.strip()]
+        unknown = [n for n in only_names if n not in gen.SCENARIOS]
+        if unknown or not only_names:
+            print(json.dumps({
+                "metric": "bench_only", "value": 0.0, "unit": "s",
+                "vs_baseline": 0.0, "platform": platform,
+                "error": f"unknown --only scenarios {unknown}",
+            }))
+            return 0
+        args.scenario = only_names[0]
     # kernel evidence must land in every TPU round's artifact (VERDICT r1
-    # item 2), so the micro-bench is opt-out, not opt-in, on TPU
-    if platform == "tpu" and not args.no_kernel:
+    # item 2), so the micro-bench is opt-out, not opt-in, on TPU —
+    # except under --only, whose contract is "scenario rows, nothing
+    # else, as fast as possible"
+    if platform == "tpu" and not args.no_kernel and not only_names:
         args.kernel = True
 
     if args.headline_only:
         args.all = False
-    if args.all:
+    # extras (cold-cached re-run, repeated jumbo/search cold runs,
+    # replay day, batch throughput) accompany the full sweep only
+    extras = args.all and not only_names
+    if only_names:
+        names = only_names
+    elif args.all:
         # importing the package is safe in the parent — the robustness
         # invariant is that the parent never *initializes* a jax backend
         # (jax.devices() is what hangs/fails, not `import jax`)
@@ -1118,8 +1190,11 @@ def main() -> int:
         # engine (VERDICT r3 item 2; adv50k extends it to 5x) and their
         # budget is a WARM number — two extra warm runs (~2 s at 10k,
         # ~15 s at 50k) buy the artifact a warm-vs-cold split like the
-        # headline's
-        warmrun = is_head or name in ("adversarial", "adv50k")
+        # headline's. --only runs everything cold: its consumers (the
+        # CI cold-path gate) compare cold wall clocks.
+        warmrun = (
+            is_head or name in ("adversarial", "adv50k")
+        ) and not only_names
         r, err = _run_child(args, name, env, warmrun=warmrun,
                             kernel=is_head)
         if r is None and platform != "cpu":
@@ -1144,7 +1219,7 @@ def main() -> int:
                   file=sys.stderr)
         if is_head:
             head, head_err = r, err
-            if r is not None and args.all:
+            if r is not None and extras:
                 # the headline child just populated the persistent
                 # compile cache: measure what a FRESH process pays now
                 # (the operationally honest cold number — every CLI /
@@ -1157,7 +1232,7 @@ def main() -> int:
 
     jumbo_runs: list[float] | None = None
     search_cold_runs: dict[str, list] | None = None
-    if args.all:
+    if extras:
         # variance discipline on the certification-heavy jumbo config:
         # 4 more FRESH processes (cold each) so the artifact carries 5
         # repeated runs, not a single lucky draw (VERDICT r3 item 3)
@@ -1189,7 +1264,7 @@ def main() -> int:
         search_cold_runs = search_cold_runs or None
 
     replay_day: dict | None = None
-    if args.all:
+    if extras:
         # the event-day replay (ISSUE 7 tentpole evidence): warm delta
         # solves vs cold re-solves over the same scripted day of
         # cluster events, compacted to the latency/quality/coalescing
@@ -1201,7 +1276,7 @@ def main() -> int:
         replay_day = _compact_replay(rr, er)
 
     batch_throughput: dict | None = None
-    if args.all or args.batch_bench:
+    if extras or args.batch_bench:
         # the batched-lane throughput scenario (PR-2 tentpole evidence):
         # one child, B in {1,2,4,8} same-bucket instances; compacted to
         # the per-width solves/s + speedup + quality flags for stdout
@@ -1219,7 +1294,8 @@ def main() -> int:
             batch_throughput = {"error": (eb or "failed")[:120]}
 
     emit(head, platform, tpu_err, args.scenario, head_err,
-         scenarios=rows if args.all else None, cold_cached=cold_cached,
+         scenarios=rows if (args.all or only_names) else None,
+         cold_cached=cold_cached,
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
          bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
          replay_day=replay_day,

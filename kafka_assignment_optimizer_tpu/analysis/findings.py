@@ -33,6 +33,7 @@ RULES: dict[str, str] = {
     "KAO106": "bare print outside obs/log.py",
     "KAO107": "kao_* metric emitted without HELP/TYPE",
     "KAO108": "chaos/resilience hook inside a traced (jit/solver-factory) body",
+    "KAO109": "per-partition Python for loop in a bound/reseat hot module",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

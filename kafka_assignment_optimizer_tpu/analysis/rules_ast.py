@@ -31,6 +31,14 @@ caught only dynamically, alignment- or platform-dependently):
   reached by jit/vmap/pallas tracing would bake the fault — or its
   absence — into the compiled executable and desynchronize SPMD
   workers; chaos is a HOST-SIDE-ONLY contract (docs/RESILIENCE.md).
+- **KAO109** per-partition Python ``for`` loops in the bound/reseat
+  hot modules (``models/bounds.py``, ``models/reseat.py``): these sit
+  on every solve's certificate critical path, and ISSUE 10 rewrote
+  their per-partition interpreter loops as vectorized numpy
+  (docs/CONSTRUCTOR.md) — a loop over ``range(...num_parts)`` (or a
+  name bound from it) regressing into one of them is almost always a
+  multi-second host stall at the 50k-partition scale. Suppressible
+  with justification for genuine cold fallbacks.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -138,6 +146,7 @@ def lint_source(
         out += _rule_key_reuse(fn, path)
     out += _rule_traced_branch(tree, path)
     out += _rule_chaos_in_traced(tree, path)
+    out += _rule_partition_loop(tree, path, rel)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -538,6 +547,67 @@ def _rule_chaos_in_traced(tree, path) -> list[Finding]:
                     "workers); inject at the dispatch call site "
                     "instead (docs/RESILIENCE.md)"))
     return out
+
+
+# ---------------------------------------------------------------- KAO109
+
+# the bound/reseat hot modules: every solve's certificate critical path
+# runs through them, so per-partition Python loops there are host
+# stalls at scale (ISSUE 10 vectorized them; docs/CONSTRUCTOR.md)
+_PARTITION_HOT_FILES = ("models/bounds.py", "models/reseat.py")
+
+
+def _rule_partition_loop(tree, path, rel) -> list[Finding]:
+    """Flag ``for`` loops that iterate per partition inside the
+    bound/reseat hot modules: a loop whose iterator is
+    ``range(<...>.num_parts ...)`` or ``range(<name>)`` where the name
+    was bound from a ``num_parts`` read in the same module. Deliberate
+    cold fallbacks carry a justified suppression
+    (``# kao: disable=KAO109 -- reason``)."""
+    if not rel.endswith(_PARTITION_HOT_FILES):
+        return []
+
+    # names assigned (anywhere in the module) from a .num_parts read —
+    # catches the `P = inst.num_parts` / `for p in range(P)` split
+    part_names: set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and _mentions_num_parts(n.value):
+            for t in n.targets:
+                names = (
+                    [t] if isinstance(t, ast.Name)
+                    else [e for e in getattr(t, "elts", [])
+                          if isinstance(e, ast.Name)]
+                )
+                part_names.update(nm.id for nm in names)
+
+    out = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.For):
+            continue
+        it = n.iter
+        if not (isinstance(it, ast.Call)
+                and _dotted(it.func)[-1:] == ["range"]):
+            continue
+        hit = any(_mentions_num_parts(a) for a in it.args) or any(
+            isinstance(a, ast.Name) and a.id in part_names
+            for a in it.args
+        )
+        if hit:
+            out.append(Finding(
+                "KAO109", path, n.lineno,
+                "per-partition Python `for` loop in a bound/reseat hot "
+                "module: this is host time on every solve's certificate "
+                "critical path — vectorize over the padded arrays "
+                "(docs/CONSTRUCTOR.md) or suppress with justification "
+                "for a genuine cold fallback"))
+    return out
+
+
+def _mentions_num_parts(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr == "num_parts"
+        for sub in ast.walk(node)
+    )
 
 
 # ---------------------------------------------------------------- KAO107

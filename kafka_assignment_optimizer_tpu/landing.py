@@ -78,10 +78,15 @@ def request_schema() -> dict:
             "POST /warmup": {
                 "request": "{'shapes': [{'brokers', 'partitions', "
                            "'rf'?, 'racks'?}, ...], 'engine'?: "
-                           "'sweep'|'chain'} — precompile executables "
-                           "for these cluster shapes (docs/BUCKETING.md)",
+                           "'sweep'|'chain', 'lanes'?: bool} — "
+                           "precompile executables for these cluster "
+                           "shapes (docs/BUCKETING.md), including the "
+                           "consolidated lane-padded batch executable "
+                           "once per bucket unless lanes=false "
+                           "(docs/CONSTRUCTOR.md)",
                 "response": "per-shape bucket, wall clock, and compile "
-                            "counters; already_warm when cached",
+                            "counters (single + lane_*); already_warm "
+                            "when cached",
             },
             "POST /clusters/<id>/events": {
                 "request": "ONE typed, epoch-fenced cluster change "

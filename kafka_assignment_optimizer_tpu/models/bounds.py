@@ -602,9 +602,14 @@ def _leader_cap_lp(inst, with_lower: bool = False,
             hi = np.concatenate(
                 [np.ones(n), np.full(B, float(p_active))]
             )
+            # variable bounds as one [n+B, 2] array: building the
+            # equivalent Python list of tuples walks every variable in
+            # the interpreter — dead host time at 150k members
+            # (ISSUE 10); identical values, so the LP (and with it the
+            # certified bound) is bit-equal
             res = linprog(
                 c, A_ub=a_ub, b_ub=b_ub, A_eq=a_eq, b_eq=b_eq,
-                bounds=[(0, 1)] * n + [(0, float(p_active))] * B,
+                bounds=np.stack([lo, hi], axis=1),
                 method="highs-ipm", options=opts,
             )
         if not res.success:
@@ -758,11 +763,17 @@ def _kept_weight_lp(inst, return_solution: bool = False):
         wf = np.maximum(
             inst.w_follower[:, :B][mrows, mcols], 0
         ).astype(np.float64)
-        bounds = (
-            [(0, 1)] * (2 * n)
-            + [(0, float(p_active))] * B
-            + [(0, r_total)] * B
-        )
+        # variable bounds as arrays (see _leader_cap_lp): the tuple
+        # list walked 2n+2B variables in the interpreter per solve —
+        # at the 50k-partition jumbo that is ~300k dead Python
+        # iterations on the constructor's critical path (ISSUE 10)
+        lo = np.zeros(ncols)
+        hi = np.concatenate([
+            np.ones(2 * n),
+            np.full(B, float(p_active)),
+            np.full(B, r_total),
+        ])
+        bounds = np.stack([lo, hi], axis=1)
         if return_solution:
             # one composite solve: weight lexicographically above
             # the kept-slot count (kept < n+1, so the scaled weight
@@ -797,8 +808,6 @@ def _kept_weight_lp(inst, return_solution: bool = False):
         # certificate-critical: when marginals exist the repaired
         # dual bound is the only sound choice (see _leader_cap_lp);
         # max with the primal value guards repair fp noise
-        lo = np.array([b[0] for b in bounds], dtype=np.float64)
-        hi = np.array([b[1] for b in bounds], dtype=np.float64)
         ub = _dual_repair_max_ub(c, a_ub, b_ub, a_eq, b_eq, lo, hi, res)
         if ub is None:
             return _safe_floor_ub(res.fun)
@@ -881,7 +890,7 @@ def _member_classes(inst):
     groups: dict = collections.defaultdict(list)
     rf_l = inst.rf.tolist()
     prh_l = inst.part_rack_hi.tolist()
-    for p in range(inst.num_parts):
+    for p in range(inst.num_parts):  # kao: disable=KAO109 -- out-of-range-weight fallback only; the vectorized np.unique grouping above serves every README-tier instance (weights <= 4)
         key = (rf_l[p], prh_l[p], tuple(sorted(per[p])))
         groups[key].append(p)
     cls_parts, cls_rf, cls_prh = [], [], []
@@ -1103,8 +1112,13 @@ def _kept_weight_agg(inst, integer: bool = False,
                 # constructor-built plans.
                 xs = sol[:n_cm]
                 ys = sol[n_cm:2 * n_cm]
-                inst._agg_weight_ub = int(
-                    (cm_wf * xs).sum() + (cm_wl * ys).sum()
+                agg_w = int((cm_wf * xs).sum() + (cm_wl * ys).sum())
+                # min-merged with any bound the unaggregated LP vertex
+                # already recorded (solvers.lp_round._unagg_plan): both
+                # are valid upper bounds, the tighter one certifies more
+                prev = getattr(inst, "_agg_weight_ub", None)
+                inst._agg_weight_ub = (
+                    agg_w if prev is None else min(prev, agg_w)
                 )
                 return {
                     "X": sol[:n_cm].astype(np.int64),

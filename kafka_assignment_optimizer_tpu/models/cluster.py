@@ -226,6 +226,13 @@ def move_diff(old: Assignment, new: Assignment) -> MoveReport:
     for key in sorted(set(old_by) | set(new_by)):
         olds = old_by.get(key)
         news = new_by.get(key)
+        if olds is not None and news is not None \
+                and olds.replicas == news.replicas:
+            # identical replica list: no adds, no removes, no leader
+            # change — skip the set algebra. On a 50k-partition
+            # decommission ~49.7k partitions take this path, which is
+            # most of move_diff's 0.7 s of host time (ISSUE 10).
+            continue
         old_set = set(olds.replicas) if olds else set()
         new_set = set(news.replicas) if news else set()
         add = sorted(new_set - old_set)

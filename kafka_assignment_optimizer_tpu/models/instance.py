@@ -227,9 +227,15 @@ class ProblemInstance:
         lead = np.bincount(np.where(self.rf > 0, a[:, 0], B), minlength=B + 1)[:B]
         rk = self.rack_of_broker[flat]  # [P, R], null -> K
         rcnt = np.bincount(rk.ravel(), minlength=K + 1)[:K]
-        # per (partition, rack) counts
-        pr = np.zeros((P, K + 1), dtype=np.int64)
-        np.add.at(pr, (np.arange(P)[:, None].repeat(R, 1), rk), 1)
+        # per (partition, rack) counts via one bincount over the
+        # flattened (partition, rack) key — np.add.at's per-element
+        # scatter cost ~0.3 s per call at 50k partitions, and this
+        # oracle runs several times per solve (ISSUE 10)
+        pr = np.bincount(
+            (np.arange(P, dtype=np.int64)[:, None] * (K + 1)
+             + rk).ravel(),
+            minlength=P * (K + 1),
+        ).reshape(P, K + 1)
         pr = pr[:, :K]
 
         def band(x, lo, hi):
@@ -360,12 +366,23 @@ class ProblemInstance:
 
     def _members(self):
         """(mrows, mcols): the (partition, broker) pairs whose slot could
-        be *kept* — current eligible members of live partitions."""
-        B = self.num_brokers
-        return np.nonzero(
-            ((self.w_leader[:, :B] > 0) | (self.w_follower[:, :B] > 0))
-            & (self.rf[:, None] > 0)
-        )
+        be *kept* — current eligible members of live partitions.
+        Memoized: the bound ladder, the plan constructor and the
+        disaggregator each re-derive it, and the nonzero scan costs
+        ~0.12 s at the 50k-partition jumbo — repeated four times that
+        was a measurable slice of the construct path (ISSUE 10). The
+        weight matrices are immutable after build, so the memo can
+        never go stale; a concurrent double fill is benign (identical
+        value)."""
+        cached = getattr(self, "_members_memo", None)
+        if cached is None:
+            B = self.num_brokers
+            cached = np.nonzero(
+                ((self.w_leader[:, :B] > 0) | (self.w_follower[:, :B] > 0))
+                & (self.rf[:, None] > 0)
+            )
+            self._members_memo = cached
+        return cached
 
     def _kept_maxflow(self, *a, **k):
         """Delegates to ``models.bounds._kept_maxflow`` (the bound/
@@ -501,9 +518,17 @@ class ProblemInstance:
         m_b = member.sum(axis=0).astype(np.int64)  # [B]
         rack = self.rack_of_broker[:B]  # [B] rack index of each broker
 
-        # A: per-partition kept cap, rack-diversity aware
-        mem_rack = np.zeros((self.num_parts, K), dtype=np.int64)
-        np.add.at(mem_rack.T, rack, member.T.astype(np.int64))
+        # A: per-partition kept cap, rack-diversity aware. Per-rack
+        # column-group sums via reduceat over rack-sorted columns: the
+        # np.add.at scatter this replaces cost ~0.3 s at 50k
+        # partitions, on the bounds_flow critical path (ISSUE 10).
+        # Racks are nonempty by construction (rack_names derive from
+        # the brokers), so no reduceat empty-segment edge case.
+        order = np.argsort(rack, kind="stable")
+        starts = np.searchsorted(rack[order], np.arange(K))
+        mem_rack = np.add.reduceat(
+            member[:, order].astype(np.int64), starts, axis=1
+        )
         per_part = np.minimum(mem_rack, self.part_rack_hi[:, None]).sum(1)
         a_cap = int(np.minimum(self.rf, per_part).sum())
 
@@ -565,7 +590,6 @@ def build_instance(
     B = len(broker_ids)
     if B == 0:
         raise ValueError("empty broker list")
-    idx_of_broker = {int(b): i for i, b in enumerate(broker_ids)}
 
     if topology is None:
         topology = Topology.single_rack(broker_ids.tolist())
@@ -612,26 +636,47 @@ def build_instance(
     topic_of_part = np.array([topic_idx[p.topic] for p in parts], dtype=np.int32)
     part_id = np.array([p.partition for p in parts], dtype=np.int32)
 
-    # current assignment -> index space; ineligible brokers -> null bucket B
+    # current assignment -> index space; ineligible brokers -> null
+    # bucket B. Vectorized over one flattened (partition, slot, broker)
+    # view (ISSUE 10): the per-partition Python fills cost ~0.35 s at
+    # the 50k-partition jumbo, on every solve's cold path. Broker-id ->
+    # index translation is a searchsorted over the (sorted) broker_ids.
+    rep_counts = np.fromiter(
+        (len(p.replicas) for p in parts), np.int64, count=P
+    )
+    n_flat = int(rep_counts.sum())
+    flat_b = np.fromiter(
+        (int(b) for p in parts for b in p.replicas), np.int64,
+        count=n_flat,
+    )
+    rows = np.repeat(np.arange(P, dtype=np.int64), rep_counts)
+    starts = np.concatenate([[0], np.cumsum(rep_counts)[:-1]]) \
+        if P else np.zeros(0, np.int64)
+    slots = np.arange(n_flat, dtype=np.int64) - starts[rows] \
+        if n_flat else np.zeros(0, np.int64)
+    pos = np.searchsorted(broker_ids, flat_b)
+    eligible = (pos < B) & (
+        broker_ids[np.minimum(pos, B - 1)] == flat_b
+    )
+    idx = np.where(eligible, pos, B).astype(np.int32)
     a0 = np.full((P, R), B, dtype=np.int32)
-    for pi, p in enumerate(parts):
-        for s, b in enumerate(p.replicas[:R]):
-            a0[pi, s] = idx_of_broker.get(int(b), B)
+    in_range = slots < R
+    a0[rows[in_range], slots[in_range]] = idx[in_range]
 
-    # objective weights (README.md:116-133, 146): see module docstring
+    # objective weights (README.md:116-133, 146): see module docstring.
+    # Follower tiers first (duplicate scatters write the same constant,
+    # so last-wins assignment equals the legacy max), then the leader
+    # tier overwrites — reproducing the legacy slot-order semantics
+    # where a broker appearing as both leader and follower keeps the
+    # leader weights.
     w_leader = np.zeros((P, B + 1), dtype=np.int32)
     w_follower = np.zeros((P, B + 1), dtype=np.int32)
-    for pi, p in enumerate(parts):
-        for s, b in enumerate(p.replicas):
-            bi = idx_of_broker.get(int(b))
-            if bi is None:
-                continue  # broker being removed: no preservation reward
-            if s == 0:
-                w_leader[pi, bi] = W_LEADER_KEEP
-                w_follower[pi, bi] = W_LEADER_DEMOTE
-            else:
-                w_leader[pi, bi] = max(w_leader[pi, bi], W_FOLLOWER_PROMOTE)
-                w_follower[pi, bi] = max(w_follower[pi, bi], W_FOLLOWER_KEEP)
+    foll = eligible & (slots > 0)
+    w_leader[rows[foll], idx[foll]] = W_FOLLOWER_PROMOTE
+    w_follower[rows[foll], idx[foll]] = W_FOLLOWER_KEEP
+    lead = eligible & (slots == 0)
+    w_leader[rows[lead], idx[lead]] = W_LEADER_KEEP
+    w_follower[rows[lead], idx[lead]] = W_LEADER_DEMOTE
 
     # bound arithmetic (README.md:158-180; SURVEY §2 rules)
     r_tot = int(rf.sum())

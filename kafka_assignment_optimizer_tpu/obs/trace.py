@@ -48,6 +48,15 @@ _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
 # when tracing is off (it sits on per-chunk and per-dispatch hot paths)
 _NULL = contextlib.nullcontext()
 
+# constructor sub-phases (ISSUE 10, docs/CONSTRUCTOR.md): nested spans
+# whose TOTAL seconds are rolled up into the solve report's ``phases``
+# dict alongside the root-level pipeline phases, so flight records and
+# bench's construct_host_s column attribute host time to the exact loop
+# the vectorized constructor rewrote. Summed (not first-occurrence like
+# the root phases) because e.g. "greedy" legitimately runs both in a
+# race worker and in _pick_seed within one solve.
+SUB_PHASES = ("bounds_flow", "greedy", "reseat", "adopt")
+
 
 def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
@@ -135,14 +144,31 @@ class Trace:
 
     def report(self) -> dict:
         """The solve report: span tree + per-phase seconds (first
-        occurrence of each direct child of the root) + trajectory."""
+        occurrence of each direct child of the root, plus the SUMMED
+        constructor sub-phases from anywhere in the tree — see
+        ``SUB_PHASES``) + trajectory."""
         t0 = self.root.start
         phases: dict[str, float] = {}
         with self._lock:
             children = list(self.root.children)
         for c in children:
-            if c.end is not None and c.name not in phases:
+            # SUB_PHASES names are excluded here even as direct root
+            # children (the host-fallback path opens "greedy" at root
+            # level): they get SUMMED totals below, and first-occurrence
+            # recording would otherwise shadow every later occurrence
+            if c.end is not None and c.name not in phases \
+                    and c.name not in SUB_PHASES:
                 phases[c.name] = round(c.end - c.start, 6)
+        sub: dict[str, float] = {}
+        stack = list(children)
+        while stack:
+            sp = stack.pop()
+            with self._lock:
+                stack.extend(sp.children)
+            if sp.name in SUB_PHASES and sp.end is not None:
+                sub[sp.name] = sub.get(sp.name, 0.0) + (sp.end - sp.start)
+        for k, v in sub.items():
+            phases[k] = round(v, 6)
         rep = {
             "trace_id": self.trace_id,
             "name": self.name,

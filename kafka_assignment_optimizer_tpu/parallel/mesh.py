@@ -110,6 +110,48 @@ def clear_exec_cache() -> None:
         _san.forget_key(key)  # post-clear compiles are cold, not thrash
 
 
+# lane-consolidation ledger (ISSUE 10): which RAW batch widths each
+# lane-padded executable bucket has served. One lane-padded executable
+# per (brokers, racks, part-bucket, rf-bucket) serves every L in
+# 2..Lmax via inert-lane masking (solvers.tpu.bucket.lane_bucket), and
+# /healthz's cache section renders this so fleet warmup cost — one lane
+# compile per bucket, not one per width — is auditable.
+_LANE_SERVED: dict[tuple, dict] = {}
+_LANE_SERVED_LOCK = threading.Lock()
+
+
+def note_lane_serve(bucket_key: tuple, lanes: int,
+                    lane_bucket: int) -> None:
+    """Record one batched dispatch: ``bucket_key`` is (brokers, racks,
+    part-bucket, rf-bucket); ``lanes`` the raw width, ``lane_bucket``
+    the padded width actually dispatched."""
+    with _LANE_SERVED_LOCK:
+        row = _LANE_SERVED.setdefault(
+            tuple(bucket_key),
+            {"lane_buckets": set(), "served_lane_counts": set(),
+             "dispatches": 0},
+        )
+        row["lane_buckets"].add(int(lane_bucket))
+        row["served_lane_counts"].add(int(lanes))
+        row["dispatches"] += 1
+
+
+def lane_serve_report() -> dict:
+    """{'BxKxPxR': {lane_buckets, served_lane_counts, dispatches}} —
+    the /healthz evidence that one lane-padded executable per bucket is
+    serving every batch width."""
+    with _LANE_SERVED_LOCK:
+        rows = {k: dict(v) for k, v in _LANE_SERVED.items()}
+    return {
+        "x".join(str(x) for x in k): {
+            "lane_buckets": sorted(v["lane_buckets"]),
+            "served_lane_counts": sorted(v["served_lane_counts"]),
+            "dispatches": v["dispatches"],
+        }
+        for k, v in sorted(rows.items())
+    }
+
+
 def _arg_signature(args) -> tuple:
     return tuple(
         (tuple(x.shape), str(x.dtype))

@@ -1770,6 +1770,12 @@ def handle_healthz() -> dict:
             "part_ladder_head": bucket.ladder(10),
             "executables_held": len(mesh._EXECUTABLES),
             "persistent_cache_dir": jax.config.jax_compilation_cache_dir,
+            # lane consolidation (ISSUE 10): the active lane-padding
+            # rungs ([] = padding off), and per bucket the padded width
+            # compiled plus the raw batch widths it has served — one
+            # lane-padded executable per bucket, not one per width
+            "lane_ladder": bucket.lane_ladder(),
+            "lane_executables": mesh.lane_serve_report(),
             **bucket.STATS.snapshot(),
         },
         "queue": _SOLVES.stats(),
@@ -1917,7 +1923,16 @@ def handle_warmup(
     them out of) a shape's row. Warm up before taking traffic — the
     startup ``--warmup`` path — or treat overlapping rows as
     approximate; per-solve counter attribution is the clean fix and is
-    deliberately out of scope here."""
+    deliberately out of scope here.
+
+    Lane consolidation (ISSUE 10): unless ``"lanes": false``, each
+    shape additionally precompiles the CONSOLIDATED lane-padded batch
+    executable — once per bucket, not once per lane count, because
+    every batch width 2..Lmax pads to one rung
+    (``solvers.tpu.bucket.lane_bucket``) and dispatches one executable
+    with the padding lanes masked inert. Before the consolidation a
+    fleet warming the coalescing path paid one compile per distinct
+    batch width per bucket."""
     if not isinstance(payload, dict):
         raise ApiError(400, "request body must be a JSON object")
     shapes = payload.get("shapes")
@@ -1928,6 +1943,9 @@ def handle_warmup(
     engine = payload.get("engine", "sweep")
     if engine not in ("sweep", "chain"):
         raise ApiError(400, "warmup 'engine' must be 'sweep' or 'chain'")
+    warm_lanes = payload.get("lanes", True)
+    if not isinstance(warm_lanes, bool):
+        raise ApiError(400, "warmup 'lanes' must be a boolean")
     parsed = [_parse_warmup_shape(sh) for sh in shapes]
 
     from .solvers.tpu import bucket
@@ -1960,7 +1978,7 @@ def handle_warmup(
         except Exception as e:
             raise ApiError(500, f"warmup solve failed: {e}") from e
         after = bucket.STATS.snapshot()
-        results.append({
+        row = {
             "shape": {"brokers": b, "partitions": p, "rf": r, "racks": k},
             "bucket_parts": stats.get("bucket_parts"),
             "bucket_rf": stats.get("bucket_rf"),
@@ -1974,8 +1992,78 @@ def handle_warmup(
             "already_warm": (
                 after["compiles_total"] == before["compiles_total"]
             ),
-        })
+        }
+        if warm_lanes:
+            row.update(_warmup_lanes(
+                current, broker_list, topo, engine, max_solve_s,
+                lock_wait_s,
+            ))
+        results.append(row)
     return {"warmed": results, "cache": bucket.STATS.snapshot()}
+
+
+def _warmup_lanes(current, broker_list, topo, engine: str,
+                  max_solve_s: float | None,
+                  lock_wait_s: float) -> dict:
+    """Precompile the consolidated lane-padded batch executables for
+    one warmup shape: ONE small batch per lane-ladder rung >= 2, each
+    padded to its rung, so every batch width 2..Lmax the coalescing
+    dispatcher can send finds its executable warm. On the default
+    ladder (1, 8) that is exactly one executable per bucket; a custom
+    multi-rung ``KAO_LANE_BUCKETS`` ladder warms each rung once
+    (the minimal width mapping to it). ``precompile=True`` keeps the
+    synthetic batches out of the flight/SLO ledgers; the batch path's
+    own defaults plus the service solve budget make the compiled chunk
+    schedule match what the coalescing dispatcher sends under
+    ``--default-deadline-s``."""
+    from .models.instance import build_instance
+    from .solvers.tpu import bucket
+    from .solvers.tpu.engine import solve_tpu_batch
+
+    rungs = [r for r in bucket.lane_ladder() if r >= 2]
+    if not rungs:
+        return {}  # lane padding off: nothing to consolidate
+    # the cheapest batch width mapping to each rung: one past the
+    # previous rung (first rung: width 2)
+    widths, prev = [], 1
+    for r in rungs:
+        widths.append(min(prev + 1, r))
+        prev = r
+
+    def _job():
+        t0 = time.perf_counter()
+        for w in widths:
+            insts = [
+                build_instance(current, broker_list, topo)
+                for _ in range(w)
+            ]
+            kw: dict = {"seeds": list(range(w)), "engine": engine,
+                        "precompile": True}
+            if max_solve_s is not None:
+                kw["time_limit_s"] = max_solve_s
+            solve_tpu_batch(insts, **kw)
+        return time.perf_counter() - t0
+
+    before = bucket.STATS.snapshot()
+    try:
+        wall = _SOLVES.submit(
+            _job, wait_s=lock_wait_s, budget_s=max_solve_s
+        )
+    except Exception as e:  # best-effort: the single-path row stands
+        _olog.warn("warmup_lanes_failed", error=repr(e)[:200])
+        return {"lane_error": repr(e)[:200]}
+    after = bucket.STATS.snapshot()
+    return {
+        "lane_bucket": rungs[-1],
+        "lane_buckets": rungs,
+        "lane_compiles": (
+            after["compiles_total"] - before["compiles_total"]
+        ),
+        "lane_wall_s": round(wall, 3),
+        "lanes_already_warm": (
+            after["compiles_total"] == before["compiles_total"]
+        ),
+    }
 
 
 def parse_warmup_flag(spec: str) -> list[dict]:

@@ -50,15 +50,24 @@ def construct(inst: ProblemInstance) -> np.ndarray | None:
     what keeps a cold process inside the 5 s budget.
 
     The aggregated realization (greedy disaggregation + flow
-    completion) can be LOSSY on instances with binding caps (observed:
-    -14 weight on the 8k-partition scale-out), while the unaggregated
-    exact-vertex decode is historically lossless exactly there — so
-    under the size threshold the caps-bind family tries the exact
-    vertex FIRST, everything else tries the cheap aggregated path
-    first, and either falls through to the other before giving up."""
+    completion) could historically be LOSSY on instances with binding
+    caps (r4 observed -14 weight on the 8k-partition scale-out with
+    the blind completion), so the caps-bind family used to solve the
+    full unaggregated LP first — 2+ s of HiGHS at 8k partitions where
+    the aggregated MILP takes ~0.2 s. The leader-aware MCMF completion
+    has since made the aggregated realization lossless on the whole
+    caps-bind benchmark family (scale_out, leader_only: weight == the
+    recorded bound, verified each run by the lossless check below), so
+    symmetry-effective instances now try the CHEAP aggregated path
+    first even when caps bind (ISSUE 10 — this is most of the
+    scale-out/leader-only cold-path win); a lossy realization still
+    falls through to the exact LP vertex exactly as before, so the r4
+    failure mode costs one cheap MILP attempt, never quality. Only
+    caps-bind instances WITHOUT effective symmetry keep the
+    exact-vertex-first order."""
     members = inst._members()[0].size
     big = members > _instance_mod.AGG_MEMBER_THRESHOLD
-    lp_first = not big and inst.caps_bind()
+    lp_first = not big and inst.caps_bind() and not inst.agg_effective()
     plan_lp = plan_agg = None
     if lp_first:
         plan_lp, vertex_w = _unagg_plan(inst, with_weight=True)
@@ -140,16 +149,31 @@ def _unagg_plan(inst: ProblemInstance, with_weight: bool = False):
         return empty
     xi = np.rint(x).astype(bool)
     yi = np.rint(y).astype(bool)
-    plan = _realize(
-        inst, xi, yi, np.rint(z).astype(np.int64),
-        sol["mrows"], sol["mcols"],
-    )
-    if not with_weight:
-        return plan
     mrows, mcols = sol["mrows"], sol["mcols"]
     wl = inst.w_leader[mrows, mcols]
     wf = np.maximum(inst.w_follower[mrows, mcols], 0)
     vertex_w = int((wf * xi).sum() + (wl * yi).sum())
+    # the weight part of the lexicographic LP optimum is a valid upper
+    # bound on ANY feasible plan's weight (every plan maps into the
+    # polytope and scale > any kept count — the same argument, and the
+    # same recording convention, as the aggregated MILP's
+    # ``_agg_weight_ub`` in models.bounds._kept_weight_agg). Recording
+    # it lets certify_optimal skip the bound-ladder LPs entirely for a
+    # losslessly realized vertex — previously the scale-out /
+    # leader-only certify path re-solved the SAME kept-replica LP a
+    # second time just to restate this number (ISSUE 10, the duplicated
+    # multi-second LP on the construct critical path). Min-merged: both
+    # recorders hold valid bounds, so the tighter one wins.
+    prev = getattr(inst, "_agg_weight_ub", None)
+    inst._agg_weight_ub = (
+        vertex_w if prev is None else min(prev, vertex_w)
+    )
+    plan = _realize(
+        inst, xi, yi, np.rint(z).astype(np.int64),
+        mrows, mcols,
+    )
+    if not with_weight:
+        return plan
     return plan, vertex_w
 
 
@@ -205,17 +229,42 @@ def _realize(inst, xi, yi, quota, mrows, mcols) -> np.ndarray | None:
             )
         if assign is None:
             flow = _complete_maxflow(inst, a, vac, quota)
-            assign = (
-                None if flow is None else [(p, b, False) for p, b in flow]
-            )
+            if flow is not None:
+                ap, ab = flow
+                assign = (ap, ab, np.zeros(ap.size, dtype=bool))
         if assign is None:
             return None
-        for p, b, _lead in assign:
-            row = a[p]
-            vac_slots = np.flatnonzero((row == B) & valid[p])
-            a[p, vac_slots[0]] = b
+        # vectorized vacancy fill (ISSUE 10): the per-assignment Python
+        # loop re-scanned each row for its first vacant slot — O(need)
+        # interpreter iterations on the jumbo completion. Identical
+        # result by construction: assignments grouped per partition in
+        # list order (stable sort) land on that partition's vacant
+        # slots in ascending slot order, exactly the order the
+        # one-at-a-time ``vac_slots[0]`` loop produced.
+        ap, ab, alead = assign
+        ordr = np.argsort(ap, kind="stable")
+        ap_s, ab_s = ap[ordr], ab[ordr]
+        first = np.r_[True, ap_s[1:] != ap_s[:-1]] if ap_s.size else \
+            np.array([], bool)
+        start = (
+            np.maximum.accumulate(
+                np.where(first, np.arange(ap_s.size), 0)
+            ) if ap_s.size else ap_s
+        )
+        rank = np.arange(ap_s.size) - start
+        vr, vc = np.nonzero((a == B) & valid)  # row-major: slots ascend
+        v_start = np.searchsorted(vr, ap_s)
+        pos = v_start + rank
+        if pos.size and (
+            (pos >= vr.size) | (vr[np.minimum(pos, vr.size - 1)] != ap_s)
+        ).any():
+            return None  # more placements than vacancies on some row
+        a[ap_s, vc[pos] if pos.size else pos] = ab_s
     else:
-        assign = []
+        assign = (
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, dtype=bool),
+        )
     if ((a == B) & valid).any():
         return None
 
@@ -226,10 +275,11 @@ def _realize(inst, xi, yi, quota, mrows, mcols) -> np.ndarray | None:
     # leader counts, its fast cycle-canceller declines (out-of-band
     # input), and every constructed solve pays the full transportation
     # LP instead — measured 3.9 s of the jumbo's 16 s wall (r4).
+    ap, ab, alead = assign
     lead_b_of = np.full(P, -1, dtype=np.int64)
-    for p, b, lead in assign:
-        if lead:
-            lead_b_of[p] = b
+    # duplicate partitions keep the LAST entry, matching the loop the
+    # scatter replaces (numpy fancy assignment is last-wins)
+    lead_b_of[ap[alead]] = ab[alead]
     lead_b_of[mrows[yi]] = mcols[yi]  # kept leaders win over coverage
     prows = np.flatnonzero(lead_b_of >= 0)
     if prows.size:
@@ -254,14 +304,119 @@ def _disaggregate(inst, agg):
 
     Partitions within a class are exchangeable (identical members,
     weights, rf, caps), so ANY realization of the counts has the same
-    objective; this greedy spreads each member's remaining demand
+    objective; the greedy spreads each member's remaining demand
     most-constrained-first, giving at most one leader per partition and
     respecting the per-rack diversity cap. The aggregate rows guarantee
     per-partition feasibility on average; the greedy can in principle
     strand demand on adversarial instances — the caller verifies the
     final plan and falls back, so a stranded realization costs nothing
     but the attempt (it returns the partial keeps, still a valid warm
-    start)."""
+    start).
+
+    Dispatches on the swappable constructor implementation
+    (``solvers.tpu.constructor``, docs/CONSTRUCTOR.md): the vectorized
+    default realizes each class with array ops (~0.85 s of per-
+    partition Python at the 50k-partition jumbo before ISSUE 10); the
+    legacy per-partition greedy stays as the parity oracle. Both
+    realize the SAME counts, so any valid realization has identical
+    weight, kept-slot count, and move count — which is exactly what
+    ``tests/test_constructor_vec.py`` pins."""
+    from .tpu import constructor as _constructor
+
+    if _constructor.use_vectorized():
+        return _disaggregate_vec(inst, agg)
+    return _disaggregate_legacy(inst, agg)
+
+
+def _disaggregate_vec(inst, agg):
+    """Vectorized realization: one pass per (class, member) — numpy
+    masks over the class's partition block replace the per-partition
+    Python loop with its per-partition sorts and Counters. Leaders are
+    laid out count-descending over the class's partitions; each
+    follower member then takes its ``X_j`` keeps on the first eligible
+    partitions (rf headroom, rack-diversity headroom, not already this
+    partition's leader)."""
+    mrows, mcols = inst._members()
+    n = mrows.size
+    B, K = inst.num_brokers, inst.num_racks
+    # member lookup: (p, b) -> flat member index, via binary search on
+    # the row-major (p, b) keys np.nonzero already emits sorted
+    keys = mrows.astype(np.int64) * (B + 1) + mcols.astype(np.int64)
+    x = np.zeros(n, dtype=bool)
+    y = np.zeros(n, dtype=bool)
+    rack_of = inst.rack_of_broker
+    cm_cls = np.asarray(agg["cm_cls"], np.int64)
+    cm_broker = np.asarray(agg["cm_broker"], np.int64)
+    X = np.asarray(agg["X"], np.int64)
+    Y = np.asarray(agg["Y"], np.int64)
+    n_cls = len(agg["cls_parts"])
+    order = np.argsort(cm_cls, kind="stable")
+    splits = np.cumsum(np.bincount(cm_cls, minlength=n_cls))[:-1]
+    by_cls = np.split(order, splits)
+    out_p: list[np.ndarray] = []
+    out_b: list[np.ndarray] = []
+    out_lead: list[np.ndarray] = []
+    for ci, parts in enumerate(agg["cls_parts"]):
+        cms = by_cls[ci]
+        if cms.size == 0:
+            continue
+        parts_a = np.asarray(parts, dtype=np.int64)
+        nP = parts_a.size
+        rf_c = int(agg["cls_rf"][ci])
+        prh = int(agg["cls_prh"][ci])
+        placed = np.zeros(nP, dtype=np.int64)
+        rack_load = np.zeros((nP, K), dtype=np.int64)
+        lead_of_part = np.full(nP, -1, dtype=np.int64)
+        ysort = cms[np.argsort(-Y[cms], kind="stable")]
+        lead_members = np.repeat(ysort, Y[ysort])
+        if lead_members.size:
+            # sum(Y) <= n_c is an aggregate constraint row, so the
+            # truncation below is defensive, not load-bearing
+            lead_members = lead_members[:nP]
+            nl = lead_members.size
+            lead_of_part[:nl] = lead_members
+            placed[:nl] = 1
+            lead_rk = rack_of[cm_broker[lead_members]]
+            rack_load[np.arange(nl), lead_rk] += 1
+            out_p.append(parts_a[:nl])
+            out_b.append(cm_broker[lead_members])
+            out_lead.append(np.ones(nl, dtype=bool))
+        for j in cms[np.argsort(-X[cms], kind="stable")].tolist():
+            xj = int(X[j])
+            if xj <= 0:
+                continue
+            rk = int(rack_of[cm_broker[j]])
+            elig = (
+                (placed < rf_c)
+                & (rack_load[:, rk] < prh)
+                & (lead_of_part != j)
+            )
+            idx = np.flatnonzero(elig)[:xj]
+            if idx.size == 0:
+                continue  # stranded demand: caller verifies, like legacy
+            placed[idx] += 1
+            rack_load[idx, rk] += 1
+            out_p.append(parts_a[idx])
+            out_b.append(np.full(idx.size, cm_broker[j], np.int64))
+            out_lead.append(np.zeros(idx.size, dtype=bool))
+    if out_p:
+        pp = np.concatenate(out_p)
+        bb = np.concatenate(out_b)
+        ll = np.concatenate(out_lead)
+        want = pp * (B + 1) + bb
+        pos = np.searchsorted(keys, want)
+        if n == 0 or (pos >= n).any() or (
+            keys[np.minimum(pos, n - 1)] != want
+        ).any():
+            return None  # a counted member is not a member: refuse
+        y[pos[ll]] = True
+        x[pos[~ll]] = True
+    return {"x": x, "y": y, "mrows": mrows, "mcols": mcols}
+
+
+def _disaggregate_legacy(inst, agg):
+    """The original per-partition greedy realization — the parity
+    oracle for ``_disaggregate_vec`` (``KAO_CONSTRUCTOR=legacy``)."""
     import collections
 
     mrows, mcols = inst._members()
@@ -367,13 +522,15 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
     pv = np.flatnonzero(vac > 0)
     if qb.size == 0 or pv.size == 0:
         return None
-    kept_rack = np.zeros((P, K + 1), dtype=np.int64)
-    np.add.at(
-        kept_rack,
-        (np.arange(P)[:, None].repeat(R, 1)[filled],
-         inst.rack_of_broker[a[filled]]),
-        1,
-    )
+    # one bincount over the flattened (partition, rack) key: np.add.at
+    # pays per-element scatter cost (~0.3 s at 50k partitions) on the
+    # completion path (ISSUE 10)
+    kept_rack = np.bincount(
+        np.arange(P, dtype=np.int64)[:, None].repeat(R, 1)[filled]
+        * (K + 1)
+        + inst.rack_of_broker[a[filled]],
+        minlength=P * (K + 1),
+    ).reshape(P, K + 1)
     rem = inst.part_rack_hi[:, None] - kept_rack[:, :K]
     qr = np.unique(rack_of[qb])
     grid_p = np.repeat(pv, qr.size)
@@ -479,22 +636,33 @@ def _complete_mcmf(inst, a, vac, leaderless, lead_quota):
         return None
     if flow != int(vac.sum()):
         return None
-    out = []
     n0 = pv.size + U
     n_plain = int((~lead_e).sum())
     p_pl, b_pl = eb_p[~lead_e], eb_b[~lead_e]
     p_ld, b_ld = eb_p[lead_e], eb_b[lead_e]
-    pf = arc_flow[n0:n0 + n_plain]
-    for i in np.flatnonzero(pf):
-        out.extend([(int(p_pl[i]), int(b_pl[i]), False)] * int(pf[i]))
+    pf = np.asarray(arc_flow[n0:n0 + n_plain], np.int64)
     # a lead candidate is placed iff its (p, k) -> mid arc carries flow;
     # it consumed lead quota iff the mid -> gate channel carried it
-    # (the bypass is a plain placement)
-    lf = arc_flow[n0 + n_plain:n0 + n_plain + n_lead]
-    gf = arc_flow[n0 + n_plain + n_lead:n0 + n_plain + 2 * n_lead]
-    for i in np.flatnonzero(lf):
-        out.extend([(int(p_ld[i]), int(b_ld[i]), bool(gf[i]))] * int(lf[i]))
-    return out
+    # (the bypass is a plain placement). Assignments returned as flat
+    # (partition, broker, via-lead-channel) arrays — np.repeat over the
+    # arc flows instead of the per-unit Python list build (ISSUE 10).
+    lf = np.asarray(arc_flow[n0 + n_plain:n0 + n_plain + n_lead],
+                    np.int64)
+    gf = np.asarray(
+        arc_flow[n0 + n_plain + n_lead:n0 + n_plain + 2 * n_lead],
+        np.int64,
+    )
+    ap = np.concatenate([
+        np.repeat(p_pl, pf), np.repeat(p_ld, lf),
+    ]).astype(np.int64)
+    ab = np.concatenate([
+        np.repeat(b_pl, pf), np.repeat(b_ld, lf),
+    ]).astype(np.int64)
+    alead = np.concatenate([
+        np.zeros(int(pf.sum()), dtype=bool),
+        np.repeat(gf > 0, lf),
+    ])
+    return ap, ab, alead
 
 
 def _complete_maxflow(inst, a, vac, quota):
@@ -513,14 +681,16 @@ def _complete_maxflow(inst, a, vac, quota):
     if qb.size == 0:
         return None
     # per-(p, rack) remaining diversity allowance
-    kept_rack = np.zeros((P, K + 1), dtype=np.int64)
     filled = a != B
-    np.add.at(
-        kept_rack,
-        (np.arange(P)[:, None].repeat(R, 1)[filled],
-         inst.rack_of_broker[a[filled]]),
-        1,
-    )
+    # one bincount over the flattened (partition, rack) key: np.add.at
+    # pays per-element scatter cost (~0.3 s at 50k partitions) on the
+    # completion path (ISSUE 10)
+    kept_rack = np.bincount(
+        np.arange(P, dtype=np.int64)[:, None].repeat(R, 1)[filled]
+        * (K + 1)
+        + inst.rack_of_broker[a[filled]],
+        minlength=P * (K + 1),
+    ).reshape(P, K + 1)
     rem = inst.part_rack_hi[:, None] - kept_rack[:, :K]  # [P, K]
 
     # sparse (p, k) pair nodes: only racks holding quota brokers, only
@@ -583,10 +753,16 @@ def _complete_maxflow(inst, a, vac, quota):
     if res.flow_value != int(vac.sum()):
         return None
     flow = res.flow.tocoo()
-    out = []
-    for i, j, f in zip(flow.row, flow.col, flow.data):
-        if f > 0 and o_pair <= i < o_brok and o_brok <= j < t:
-            p = int(pk_p[i - o_pair])
-            b = int(j - o_brok)
-            out.extend([(p, b)] * int(f))
-    return out
+    # vectorized extraction of the pair -> broker arcs (the per-edge
+    # Python loop walked every arc of the flow matrix; ISSUE 10)
+    fi = np.asarray(flow.row, np.int64)
+    fj = np.asarray(flow.col, np.int64)
+    fd = np.asarray(flow.data, np.int64)
+    keep = (
+        (fd > 0) & (fi >= o_pair) & (fi < o_brok)
+        & (fj >= o_brok) & (fj < t)
+    )
+    fi, fj, fd = fi[keep], fj[keep], fd[keep]
+    ap = np.repeat(pk_p[fi - o_pair], fd).astype(np.int64)
+    ab = np.repeat(fj - o_brok, fd).astype(np.int64)
+    return ap, ab

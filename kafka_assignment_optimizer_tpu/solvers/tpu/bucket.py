@@ -60,6 +60,21 @@ _LADDER_TILE = 256
 # value, then multiples of 4. Padded slots are ordinary invalid slots.
 _RF_LADDER = (1, 2, 3, 4, 5, 6, 8)
 
+# lane ladder (ISSUE 10): batched multi-instance solves pad their lane
+# count up to a rung so ONE lane-padded executable per bucket serves
+# every batch width L in 2..Lmax — without it, each distinct L compiled
+# its own executable on first contact (the per-(bucket, lane-count)
+# executable zoo) and burned an exec-cache slot per width. Rung 1 is
+# deliberate: the B=1 path stays raw so single-lane dispatches (and the
+# bench's sequential-baseline arm) never pay padded-lane device work.
+# Padded lanes are inert by masking at selection: they anneal a copy of
+# lane 0's instance and their results are never read.
+#
+# ``KAO_LANE_BUCKETS=off`` disables (raw lane counts);
+# ``KAO_LANE_BUCKETS=2,4,8`` overrides the rung list. Batches above the
+# top rung stay raw, exactly like the partition ladder.
+_LANE_LADDER = (1, 8)
+
 
 def _round_up(v: int, align: int) -> int:
     return -(-int(v) // align) * align
@@ -107,6 +122,40 @@ def part_bucket(num_parts: int) -> int:
     while v < p:
         v = _next_rung(v)
     return v
+
+
+def _lane_ladder() -> tuple[int, ...] | None:
+    """The active lane ladder, or None when lane padding is off."""
+    raw = os.environ.get("KAO_LANE_BUCKETS", "")
+    if raw.lower() in ("off", "0", "none", "false"):
+        return None
+    if not raw or raw.lower() in ("on", "1", "true"):
+        return _LANE_LADDER
+    try:
+        rungs = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return _LANE_LADDER  # malformed override: default ladder
+    return tuple(rungs) or _LANE_LADDER
+
+
+def lane_bucket(lanes: int) -> int:
+    """Smallest lane-ladder rung >= lanes (identity when lane padding
+    is disabled, or above the top rung)."""
+    n = int(lanes)
+    ladder = _lane_ladder()
+    if ladder is None:
+        return n
+    for rung in ladder:
+        if rung >= n:
+            return rung
+    return n
+
+
+def lane_ladder() -> list[int]:
+    """The ACTIVE lane ladder rungs (for /healthz and docs); empty when
+    lane padding is disabled."""
+    ladder = _lane_ladder()
+    return [] if ladder is None else list(ladder)
 
 
 def rf_bucket(max_rf: int) -> int:

@@ -1,0 +1,68 @@
+"""Swappable constructor implementation registry (ISSUE 10).
+
+The host-bound constructor path — greedy placement (``seed.py``), the
+aggregated-MILP disaggregation (``solvers.lp_round``), and their shared
+repair machinery — exists in two implementations:
+
+- ``"vec"`` (the default): the per-partition Python loops rewritten as
+  vectorized numpy over the same padded arrays the annealer uses
+  (docs/CONSTRUCTOR.md). This is the production path.
+- ``"legacy"``: the original per-partition Python implementation, kept
+  verbatim as the ORACLE — ``tests/test_constructor_vec.py`` pins the
+  vectorized path against it plan-for-plan (or rank-for-rank where the
+  algorithms legitimately tie-break differently), and it remains the
+  operator's fallback rung when a vectorization bug ships
+  (``KAO_CONSTRUCTOR=legacy``, no redeploy needed).
+
+The registry is deliberately tiny and dependency-free: ``seed.py`` and
+``lp_round.py`` consult :func:`active` at call time, and the engine
+re-exports :func:`set_impl` so tests and the serve layer can flip the
+implementation per process. The env var is read once at import; the
+setter wins afterwards.
+"""
+
+from __future__ import annotations
+
+import os
+
+IMPLS = ("vec", "legacy")
+
+_DEFAULT = os.environ.get("KAO_CONSTRUCTOR", "vec").strip().lower()
+if _DEFAULT not in IMPLS:
+    # a typo'd override must not SILENTLY select an implementation the
+    # operator did not ask for: the whole point of the env var is the
+    # no-redeploy fallback rung, and a misspelled "legacy" quietly
+    # running "vec" would defeat it. Same loud-decline convention as
+    # the chaos spec parser (docs/RESILIENCE.md) — logged, then the
+    # default proceeds (raising here would brick every entry point on
+    # an env typo).
+    from ...obs import log as _olog
+
+    _olog.warn("kao_constructor_invalid",
+               value=os.environ.get("KAO_CONSTRUCTOR", ""),
+               expected="|".join(IMPLS), using="vec")
+    _DEFAULT = "vec"
+
+_ACTIVE = _DEFAULT
+
+
+def active() -> str:
+    """The currently selected constructor implementation name."""
+    return _ACTIVE
+
+
+def set_impl(name: str) -> str:
+    """Select the constructor implementation process-wide. Returns the
+    previous value so tests can restore it."""
+    global _ACTIVE
+    if name not in IMPLS:
+        raise ValueError(
+            f"unknown constructor impl {name!r}; expected one of {IMPLS}"
+        )
+    prev = _ACTIVE
+    _ACTIVE = name
+    return prev
+
+
+def use_vectorized() -> bool:
+    return _ACTIVE == "vec"

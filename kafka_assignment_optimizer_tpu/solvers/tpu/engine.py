@@ -37,7 +37,17 @@ from ...resilience.budget import Budget
 from ...utils import checkpoint as ckpt
 from ..base import SolveResult, register
 from . import arrays
+from . import constructor as _constructor
 from .seed import greedy_seed
+
+# swappable constructor interface (ISSUE 10, docs/CONSTRUCTOR.md): the
+# vectorized host constructor is the default; the legacy per-partition
+# implementation stays selectable as the oracle / fallback rung
+# (KAO_CONSTRUCTOR=legacy, or set_constructor_impl("legacy") in
+# process). Re-exported here because the engine is the constructor's
+# one orchestration point — every solve enters through these workers.
+set_constructor_impl = _constructor.set_impl
+constructor_impl = _constructor.active
 
 
 # partition count at which the sweep-parallel engine takes over from the
@@ -363,10 +373,18 @@ def _solve_tpu(
     # thread — unlike a ThreadPoolExecutor worker — cannot stall
     # interpreter exit if the solve dies while a 50k-partition LP is
     # still grinding.)
-    bounds_fut = _BoundsTask(_otrace.wrap(
-        "bounds",
-        lambda: (inst.move_lower_bound_exact(), inst.weight_upper_bound()),
-    ))
+    def _bounds_body():
+        # sub-phase span (ISSUE 10): the flow/LP bound computation gets
+        # its own kao_phase_seconds{phase="bounds_flow"} attribution so
+        # flight records can tell the host loop being vectorized apart
+        # from the join wait the parent "bounds" span also contains
+        with _otrace.span("bounds_flow"):
+            return (
+                inst.move_lower_bound_exact(),
+                inst.weight_upper_bound(),
+            )
+
+    bounds_fut = _BoundsTask(_otrace.wrap("bounds", _bounds_body))
     # when balance bands bind, a second worker decodes the kept-replica
     # LP into a plan (solvers.lp_round) — usually the certified global
     # optimum, letting the solve skip annealing (and often compilation)
@@ -601,7 +619,8 @@ def _host_fallback(inst: ProblemInstance, exc: BaseException,
     on slack-caps instances greedy + exact reseat often IS the proven
     optimum, in which case the degraded plan is also certified."""
     _ladder.note_rung("anneal_to_construct", error=repr(exc)[:200])
-    a = np.asarray(greedy_seed(inst), dtype=np.int32)
+    with _otrace.span("greedy"):
+        a = np.asarray(greedy_seed(inst), dtype=np.int32)
     resumed = False
     warm_used = False
     if checkpoint:
@@ -689,14 +708,16 @@ def _reseat_worker(inst: ProblemInstance, bounds_fut) -> tuple:
     rides in the result tuple rather than on the shared instance so a
     straggling worker from a PREVIOUS solve can never tag the next
     solve's warm start (ADVICE r4)."""
-    a = np.asarray(greedy_seed(inst), dtype=np.int32)
+    with _otrace.span("greedy"):
+        a = np.asarray(greedy_seed(inst), dtype=np.int32)
     if not inst.is_feasible(a):
         return None, False, False  # greedy is only near-feasible here
     try:
         bounds_fut.result()
     except Exception:
         pass
-    a = inst.best_leader_assignment(a)
+    with _otrace.span("reseat"):
+        a = inst.best_leader_assignment(a)
     # record the path unconditionally — an uncertified warm start can
     # still win final selection (constructed=True in stats), and its
     # construct_path must then name what actually built it rather
@@ -810,7 +831,8 @@ def _warm_certify_worker(inst: ProblemInstance, bounds_fut, warm_a,
     # replica sets) — so gate only on the families reseat cannot touch
     if all(v == 0 for k, v in viol.items() if k != "leader_balance"):
         try:
-            a = inst.best_leader_assignment(a)
+            with _otrace.span("reseat"):
+                a = inst.best_leader_assignment(a)
         except Exception:
             pass  # infeasible transportation: fall through uncertified
         if inst.certify_optimal(a):
@@ -889,13 +911,20 @@ def _await_constructor(lp_fut, lp_wait_s, checkpoint, budget: Budget):
         lp_warm_extends = bool(lp_warm_extends)
     except Exception:
         plan, ok = None, False
-    if ok:
-        return np.asarray(plan, dtype=np.int32), None, lp_warm_extends
-    if plan is not None:
-        # uncertified but complete: candidate warm start, ranked
-        # against the greedy seed in stage 2
-        return None, np.asarray(plan, dtype=np.int32), lp_warm_extends
-    return None, None, lp_warm_extends
+    # "adopt" sub-phase (ISSUE 10): the host time spent taking a
+    # finished constructor plan into the solve — distinct from the
+    # join wait above, which is overlap, not work
+    with _otrace.span("adopt", certified=bool(ok),
+                      plan=plan is not None):
+        if ok:
+            return (np.asarray(plan, dtype=np.int32), None,
+                    lp_warm_extends)
+        if plan is not None:
+            # uncertified but complete: candidate warm start, ranked
+            # against the greedy seed in stage 2
+            return (None, np.asarray(plan, dtype=np.int32),
+                    lp_warm_extends)
+        return None, None, lp_warm_extends
 
 
 @dataclass
@@ -1094,8 +1123,10 @@ def _run_ladder(
             except Exception:
                 plan, ok = None, False
             if ok:
-                r.certified_a = np.asarray(plan, dtype=np.int32)
-                r.constructed = True
+                with _otrace.span("adopt", certified=True,
+                                  boundary=i):
+                    r.certified_a = np.asarray(plan, dtype=np.int32)
+                    r.constructed = True
                 return True
         # boundary certificate: if any per-shard winner provably hits
         # the optimum, the remaining chunks cannot improve it. (The
@@ -1419,7 +1450,11 @@ def _pick_seed(inst, lp_warm, lp_warm_extends, checkpoint,
     resumed = False
     warm_used = False
     warm_extends = lp_warm is not None and lp_warm_extends
-    a_seed = lp_warm if warm_extends else greedy_seed(inst)
+    if warm_extends:
+        a_seed = lp_warm
+    else:
+        with _otrace.span("greedy"):
+            a_seed = greedy_seed(inst)
     assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
         "seed left unfilled slots"
     )
@@ -2007,6 +2042,10 @@ def _solve_tpu_inner(
                 getattr(inst, "_construct_path", None)
                 if constructed else None
             ),
+            # which constructor implementation served this solve
+            # (docs/CONSTRUCTOR.md): "vec" by default, "legacy" when
+            # the oracle/fallback rung was selected
+            "constructor_impl": _constructor.active(),
             # best known lower bound: the LP sharpening when it was
             # (lazily) evaluated, else the counting bound
             "moves_lb": (
@@ -2090,7 +2129,13 @@ def solve_tpu_batch(*args, **kwargs) -> list[SolveResult]:
     dispatch, not the lane alone; the per-lane quality columns are the
     lane's own. The accumulator also suppresses the per-lane
     ``solve_tpu`` records on the unstackable-fallback path — every
-    lane lands exactly one record either way."""
+    lane lands exactly one record either way.
+
+    ``precompile=True`` (serve's lane warmup, ISSUE 10) marks the batch
+    synthetic: like the single path's precompile solves it is never
+    flight-recorded — a warmup must not burn SLO budget or skew the
+    lane-latency histograms."""
+    precompile = bool(kwargs.get("precompile"))
     nested = _flight.accounting_active()
     acc_tok = None if nested else _flight.start_accounting()
     t0 = time.perf_counter()
@@ -2108,7 +2153,7 @@ def solve_tpu_batch(*args, **kwargs) -> list[SolveResult]:
             _flight.end_accounting(acc_tok) if acc_tok is not None
             else None
         )
-        if acc is not None:
+        if acc is not None and not precompile:
             # the whole batched dispatch failed: one failure record
             # per lane, same accounting as the success path
             for inst in insts:
@@ -2120,7 +2165,7 @@ def solve_tpu_batch(*args, **kwargs) -> list[SolveResult]:
         _flight.end_accounting(acc_tok) if acc_tok is not None
         else None
     )
-    if acc is not None:
+    if acc is not None and not precompile:
         for inst, r in zip(insts, results):
             _flight.record_solve(r, inst, acc, kind="lane")
     return results
@@ -2141,6 +2186,7 @@ def _solve_tpu_batch_impl(
     certify: bool = False,
     trace: bool | str | None = None,
     pipeline: bool | None = None,
+    precompile: bool = False,  # consumed by the solve_tpu_batch wrapper
 ) -> list[SolveResult]:
     """Solve L independent instances in ONE batched device dispatch —
     the multi-tenant throughput path (serve's coalescing dispatcher and
@@ -2297,24 +2343,46 @@ def _solve_batch_body(
     bkt_parts = max(bucket.part_bucket(i.num_parts) for i in insts)
     bkt_rf = max(bucket.rf_bucket(i.max_rf) for i in insts)
     B, K = insts[0].num_brokers, insts[0].num_racks
+    # lane consolidation (ISSUE 10): pad the batch width up its own
+    # ladder rung so ONE lane-padded executable per bucket serves every
+    # L in 2..Lmax — previously each distinct L compiled its own
+    # executable on first contact. Padded lanes anneal a COPY of lane 0
+    # (distinct per-lane RNG keys, so real-lane trajectories are
+    # untouched by vmap width — the B=1 bit-parity anchor generalizes)
+    # and are inert by masking: selection below iterates the REAL
+    # instances only, so a padded lane's results are never read.
+    Lp = bucket.lane_bucket(L)
+    pad_lanes = Lp - L
+    from ...parallel.mesh import note_lane_serve
+
+    note_lane_serve((B, K, bkt_parts, bkt_rf), L, Lp)
     models = []
-    lane_seeds = np.empty((L, bkt_parts, bkt_rf), np.int32)
+    lane_seeds = np.empty((Lp, bkt_parts, bkt_rf), np.int32)
     with _otrace.span("seed", lanes=L):
         for i, inst in enumerate(insts):
             bucket.STATS.record_bucket(
                 (B, K, bkt_parts, bkt_rf),
                 padded=(
-                    (bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf)
+                    (bkt_parts, bkt_rf)
+                    != (inst.num_parts, inst.max_rf)
                 ),
             )
             m = arrays.from_instance(inst, num_parts=bkt_parts,
                                      max_rf=bkt_rf)
             models.append(m)
-            a_seed = np.asarray(greedy_seed(inst), dtype=np.int32)
-            assert (a_seed[inst.slot_valid] < inst.num_brokers).all(), (
-                "seed left unfilled slots"
-            )
+            # the greedy sub-phase wraps ONLY the repair itself: array
+            # packing/padding must not inflate construct_host_s's
+            # greedy attribution (sub-phase contract,
+            # docs/OBSERVABILITY.md); per-lane spans sum in the roll-up
+            with _otrace.span("greedy", lane=i):
+                a_seed = np.asarray(greedy_seed(inst), dtype=np.int32)
+            assert (
+                a_seed[inst.slot_valid] < inst.num_brokers
+            ).all(), "seed left unfilled slots"
             lane_seeds[i] = arrays.pad_candidate(a_seed, m)
+        for i in range(pad_lanes):
+            models.append(models[0])
+            lane_seeds[L + i] = lane_seeds[0]
         m_stack = arrays.stack_models(models)
         seed_moves = [int(inst.move_count(arrays.unpad_candidate(
             lane_seeds[i], inst))) for i, inst in enumerate(insts)]
@@ -2322,7 +2390,15 @@ def _solve_batch_body(
     mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
     chains_per_device = max(1, batch // n_dev)
-    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    # padded lanes get derived keys so no two lanes ever consume one
+    # stream (their results are discarded either way)
+    pad_keys = [
+        jax.random.fold_in(jax.random.PRNGKey(seeds[0]), 1 + i)
+        for i in range(pad_lanes)
+    ]
+    keys = jnp.stack(
+        [jax.random.PRNGKey(s) for s in seeds] + pad_keys
+    )
     scorer = "pallas" if (platform == "tpu" and engine == "sweep") else "xla"
 
     # chunked ladder + between-chunk clock checks — the same deadline
@@ -2346,12 +2422,14 @@ def _solve_batch_body(
     pipelined = False
     # warm-chunk estimate: per-solve measurement (chunk 0 and fallback
     # chunks excluded — compile-inclusive) plus the cross-solve prior.
-    # The "lanes" tag + L keep this key space disjoint from the
-    # sequential path's: a slow first batched chunk must never inflate
-    # solve_tpu's deadline estimate, and vice versa.
+    # The "lanes" tag + the PADDED width keep this key space disjoint
+    # from the sequential path's: a slow first batched chunk must never
+    # inflate solve_tpu's deadline estimate, and vice versa. Lp (not L)
+    # because the executable — and with it the chunk duration — is the
+    # padded one: every width sharing a lane bucket shares the estimate.
     warm_chunk_s: float | None = None
     chunk_len = int(chunks[0].shape[0]) if n else 0
-    warm_key = ("lanes", L, engine, n_dev, chains_per_device,
+    warm_key = ("lanes", Lp, engine, n_dev, chains_per_device,
                 steps_per_round, int(bkt_parts), int(bkt_rf))
 
     def _wkey():
@@ -2449,11 +2527,13 @@ def _solve_batch_body(
             if engine != "sweep" and ci + 1 < n and not over:
                 # chain boundary reseed: each lane continues from its
                 # best shard winner with a fresh per-lane key stream
+                # (padded lanes included — their state must keep the
+                # stacked shape even though their results are masked)
                 pa_np = np.asarray(fetch_global(pop_a))
                 pk_np = np.asarray(fetch_global(pop_k))
-                top = pk_np.argmax(axis=0)  # [L]
+                top = pk_np.argmax(axis=0)  # [Lp]
                 cur_seeds = np.stack(
-                    [pa_np[top[i], i] for i in range(L)]
+                    [pa_np[top[i], i] for i in range(Lp)]
                 ).astype(np.int32)
                 cur_keys = jax.vmap(jax.random.split)(cur_keys)[:, 1]
             if over:
@@ -2552,7 +2632,7 @@ def _solve_batch_body(
             insts, pa, curve_np, n_dev, certify, wall, t_solve, t0,
             platform, engine, L, chains_per_device, rounds, rounds_run,
             timed_out, bkt_parts, bkt_rf, scorer, pallas_fallback,
-            time_limit_s, seed_moves, pipelined,
+            time_limit_s, seed_moves, pipelined, lane_bucket=Lp,
         )
         if _vsp is not None:
             _vsp.set(lanes_feasible=sum(
@@ -2564,10 +2644,12 @@ def _select_lanes(
     insts, pa, curve_np, n_dev, certify, wall, t_solve, t0, platform,
     engine, L, chains_per_device, rounds, rounds_run, timed_out,
     bkt_parts, bkt_rf, scorer, pallas_fallback, time_limit_s, seed_moves,
-    pipelined=False,
+    pipelined=False, lane_bucket=None,
 ) -> list[SolveResult]:
     """Per-lane final selection + oracle verification (the batch path's
-    "verify" phase body)."""
+    "verify" phase body). Iterates the REAL instances only — this loop
+    IS the inert-lane mask: a lane-padded dispatch's padding lanes
+    (indices >= len(insts)) are simply never read."""
     results = []
     for i, inst in enumerate(insts):
         best_a = None
@@ -2594,6 +2676,11 @@ def _select_lanes(
                 "engine": engine,
                 "lanes": L,
                 "lane": i,
+                # padded dispatch width (lane consolidation, ISSUE 10):
+                # the executable that served this batch was compiled
+                # for lane_bucket lanes, shared by every L it covers
+                **({"lane_bucket": int(lane_bucket)}
+                   if lane_bucket is not None else {}),
                 "devices": n_dev,
                 "chains_per_device": chains_per_device,
                 "rounds": rounds,

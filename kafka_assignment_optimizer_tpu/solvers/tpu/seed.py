@@ -22,6 +22,24 @@ donor slot with the least preservation weight and the recipient broker
 with the least load — keeping the seed near the move-count optimum the
 exact backends find. Residual violations (rare, small) are the annealing
 engine's job.
+
+Two implementations share this module (docs/CONSTRUCTOR.md, the
+swappable constructor interface in ``solvers.tpu.constructor``):
+
+- ``_Repair`` — the ORIGINAL per-partition Python implementation, kept
+  verbatim as the parity oracle and the operator's fallback rung
+  (``KAO_CONSTRUCTOR=legacy``).
+- ``_RepairVec`` — the vectorized default: no per-slot Python set
+  bookkeeping (the legacy ``slots_of`` build alone walks P*R slots in
+  Python — ~150k iterations at the 50k-partition jumbo), O(1)
+  membership tests via a scatter-built count matrix, cached
+  lexsort-ordered donor lists for the band-repair relocations, and the
+  leader-chain BFS (phase 3) on flat numpy edge arrays instead of a
+  per-partition adjacency-dict build per augmentation. Decisions are
+  deliberately bit-identical to the legacy path — same donor order,
+  same recipient lexsort, same BFS scan order — so the parity pin in
+  ``tests/test_constructor_vec.py`` is plan-for-plan, not merely
+  rank-for-rank.
 """
 
 from __future__ import annotations
@@ -29,9 +47,14 @@ from __future__ import annotations
 import numpy as np
 
 from ...models.instance import ProblemInstance
+from . import constructor as _constructor
 
 
 class _Repair:
+    """Legacy per-partition implementation — the parity oracle. Do not
+    optimize in place; speedups belong in :class:`_RepairVec` so this
+    path keeps witnessing the original semantics."""
+
     def __init__(self, inst: ProblemInstance):
         self.inst = inst
         B, K, P, R = inst.num_brokers, inst.num_racks, inst.num_parts, inst.max_rf
@@ -40,6 +63,7 @@ class _Repair:
         self.rack = inst.rack_of_broker  # [B+1]
         self.a = inst.a0.copy()
         valid = inst.slot_valid
+        self.valid = valid
         flat = np.where(valid, self.a, B)
         self.cnt = np.bincount(flat.ravel(), minlength=B + 1)[:B].astype(np.int64)
         self.lcnt = np.bincount(
@@ -52,12 +76,17 @@ class _Repair:
         rows = np.repeat(np.arange(P), R)
         rk = self.rack[flat].ravel()
         np.add.at(self.prc, (rows[rk < K], rk[rk < K]), 1)
+        self._init_slots()
+
+    def _init_slots(self) -> None:
         # replica slots per broker, for donor selection
-        self.slots_of: list[set[tuple[int, int]]] = [set() for _ in range(B)]
-        for p in range(P):
+        self.slots_of: list[set[tuple[int, int]]] = [
+            set() for _ in range(self.B)
+        ]
+        for p in range(self.P):
             for s in range(int(self.rf[p])):
                 b = int(self.a[p, s])
-                if b < B:
+                if b < self.B:
                     self.slots_of[b].add((p, s))
 
     # -- primitives -----------------------------------------------------
@@ -84,6 +113,15 @@ class _Repair:
             if s == 0:
                 self.lcnt[b_new] += 1
             self.slots_of[b_new].add((p, s))
+
+    def _note_swap(self, p: int, s: int, bl: int, bf: int) -> None:
+        """Bookkeeping hook for a leader<->follower swap of partition
+        ``p`` slots (0, s): brokers keep their partition membership, only
+        the slot indices trade."""
+        self.slots_of[bl].discard((p, 0))
+        self.slots_of[bl].add((p, s))
+        self.slots_of[bf].discard((p, s))
+        self.slots_of[bf].add((p, 0))
 
     def choose_broker(self, p: int, allowed: np.ndarray) -> int:
         """Best recipient among `allowed` (bool mask [B]) for a replica of
@@ -241,10 +279,7 @@ class _Repair:
             self.a[p, 0], self.a[p, s] = bf, bl
             self.lcnt[bl] -= 1
             self.lcnt[bf] += 1
-            self.slots_of[bl].discard((p, 0))
-            self.slots_of[bl].add((p, s))
-            self.slots_of[bf].discard((p, s))
-            self.slots_of[bf].add((p, 0))
+            self._note_swap(p, s, bl, bf)
 
         # phase 1 — potential descent: repeatedly hand leadership of some
         # partition to its least-leading follower while that strictly
@@ -345,11 +380,19 @@ class _Repair:
             swap(p, int(s_best[p]) + 1)
             prev_p = p
 
-        # phase 3 — BFS augmenting chains for what descent cannot reach:
-        # route one unit of leadership from an over-hi broker to any broker
-        # with headroom (or from any broker with slack to an under-lo one)
-        # through a path of leader<->follower swaps. Exact; each
-        # augmentation reduces total band violation by >= 1.
+        # phase 3 — BFS augmenting chains for what descent cannot reach
+        # (implementation-swappable: _RepairVec overrides with the
+        # flat-edge-array BFS; semantics identical)
+        self._augment_leader_chains(max_repairs, lo, hi, swap)
+
+    def _augment_leader_chains(self, max_repairs: int, lo: int, hi: int,
+                               swap) -> None:
+        """Phase 3 — BFS augmenting chains for what descent cannot reach:
+        route one unit of leadership from an over-hi broker to any broker
+        with headroom (or from any broker with slack to an under-lo one)
+        through a path of leader<->follower swaps. Exact; each
+        augmentation reduces total band violation by >= 1."""
+        B = self.B
         for _ in range(max_repairs):
             over = np.flatnonzero(self.lcnt > hi)
             under = np.flatnonzero(self.lcnt < lo)
@@ -408,10 +451,175 @@ class _Repair:
                 node = u
 
 
-def greedy_seed(inst: ProblemInstance, max_repairs: int | None = None) -> np.ndarray:
+class _RepairVec(_Repair):
+    """Vectorized implementation (the default): identical decisions to
+    the legacy path — same donor ordering, same recipient lexsort, same
+    BFS scan order — with the per-partition Python loops replaced by
+    numpy array work (docs/CONSTRUCTOR.md has the layout)."""
+
+    def _init_slots(self) -> None:
+        # membership counts [P, B+1] built with one scatter-add instead
+        # of the legacy P*R Python set loop; used_mask and the duplicate
+        # guard read rows of this in O(B)
+        flat = np.where(self.valid, self.a, self.B)
+        self.in_part = np.zeros((self.P, self.B + 1), dtype=np.int16)
+        np.add.at(
+            self.in_part,
+            (np.repeat(np.arange(self.P), self.R), flat.ravel()),
+            1,
+        )
+        # donor lists per broker, built lazily (lexsort over that
+        # broker's slots) and invalidated whenever the broker's slot set
+        # changes; None marks "not built"
+        self._donor_cache: dict[int, list] = {}
+
+    def set_slot(self, p: int, s: int, b_new: int) -> None:
+        b_old = int(self.a[p, s])
+        if b_old < self.B:
+            self.cnt[b_old] -= 1
+            self.rcnt[self.rack[b_old]] -= 1
+            self.prc[p, self.rack[b_old]] -= 1
+            if s == 0:
+                self.lcnt[b_old] -= 1
+            self.in_part[p, b_old] -= 1
+            self._donor_cache.pop(b_old, None)
+        self.a[p, s] = b_new
+        if b_new < self.B:
+            self.cnt[b_new] += 1
+            self.rcnt[self.rack[b_new]] += 1
+            self.prc[p, self.rack[b_new]] += 1
+            if s == 0:
+                self.lcnt[b_new] += 1
+            self.in_part[p, b_new] += 1
+            self._donor_cache.pop(b_new, None)
+
+    def _note_swap(self, p: int, s: int, bl: int, bf: int) -> None:
+        # membership counts are slot-order-blind; only the cached donor
+        # lists (which carry slot indices) go stale
+        self._donor_cache.pop(bl, None)
+        self._donor_cache.pop(bf, None)
+
+    def used_mask(self, p: int) -> np.ndarray:
+        return self.in_part[p, : self.B] > 0
+
+    def _donor_list(self, src: int) -> list:
+        lst = self._donor_cache.get(src)
+        if lst is None:
+            ps, ss = np.nonzero((self.a == src) & self.valid)
+            w = np.where(
+                ss == 0,
+                self.inst.w_leader[ps, src],
+                self.inst.w_follower[ps, src],
+            ).astype(np.int64)
+            order = np.lexsort((ss, ps, w))  # (weight, p, s) — legacy order
+            lst = list(
+                zip(ps[order].tolist(), ss[order].tolist())
+            )
+            self._donor_cache[src] = lst
+        return lst
+
+    def relocate_one(self, src: int, dst_mask: np.ndarray) -> bool:
+        inst, rack = self.inst, self.rack[: self.B]
+        lst = self._donor_list(src)
+        fallback: tuple[int, int, int] | None = None
+        fallback_i = -1
+        for i, (p, s) in enumerate(lst):
+            b = self.choose_broker(p, dst_mask & ~self.used_mask(p))
+            if b < 0:
+                continue
+            same_rack = rack[b] == rack[src]
+            if self.prc[p, rack[b]] + 1 - same_rack <= inst.part_rack_hi[p]:
+                self.set_slot(p, s, b)  # invalidates src's cache...
+                lst.pop(i)
+                self._donor_cache[src] = lst  # ...which we repair exactly
+                return True
+            if fallback is None:
+                fallback = (p, s, b)
+                fallback_i = i
+        if fallback is not None:
+            p, s, b = fallback
+            self.set_slot(p, s, b)
+            lst.pop(fallback_i)
+            self._donor_cache[src] = lst
+            return True
+        return False
+
+    def _augment_leader_chains(self, max_repairs: int, lo: int, hi: int,
+                               swap) -> None:
+        """Phase 3 on flat edge arrays: one ``np.nonzero`` builds every
+        leader->follower edge per augmentation (vs the legacy
+        per-partition adjacency-dict walk), and each BFS level resolves
+        first-visit parents with one lexsort + unique. Scan order —
+        (frontier position, edge (p, s) order) — matches the legacy
+        dict/list iteration exactly, so the unwound augmenting path is
+        the same path and the resulting plan is bit-identical."""
+        B = self.B
+        for _ in range(max_repairs):
+            over = np.flatnonzero(self.lcnt > hi)
+            under = np.flatnonzero(self.lcnt < lo)
+            if not (len(over) or len(under)):
+                break
+            mask = self.valid.copy()
+            mask[:, 0] = False
+            mask &= (self.a[:, [0]] < B) & (self.a < B)
+            ep, es = np.nonzero(mask)
+            src_b = self.a[ep, 0].astype(np.int64)
+            dst_b = self.a[ep, es].astype(np.int64)
+            if len(over):
+                srcs = {int(b) for b in over}
+                dst_ok = self.lcnt < hi
+            else:
+                srcs = {b for b in range(B) if self.lcnt[b] > lo}
+                dst_ok = np.zeros(B, dtype=bool)
+                dst_ok[list({int(b) for b in under})] = True
+            # frontier built exactly as the legacy set->list conversion,
+            # so level-0 scan order (and with it the chosen path) matches
+            frontier = list(srcs)
+            seen = np.zeros(B, dtype=bool)
+            seen[frontier] = True
+            parent_edge = np.full(B, -1, dtype=np.int64)
+            rank = np.full(B, -1, dtype=np.int64)
+            goal = -1
+            while frontier and goal < 0:
+                rank[:] = -1
+                rank[frontier] = np.arange(len(frontier))
+                cand = np.flatnonzero((rank[src_b] >= 0) & ~seen[dst_b])
+                if cand.size == 0:
+                    break
+                order = cand[np.lexsort((cand, rank[src_b[cand]]))]
+                d_ord = dst_b[order]
+                uniq_d, first_idx = np.unique(d_ord, return_index=True)
+                scan = np.argsort(first_idx)  # restore scan order
+                uniq_d, first_idx = uniq_d[scan], first_idx[scan]
+                parent_edge[uniq_d] = order[first_idx]
+                seen[uniq_d] = True
+                goals = np.flatnonzero(dst_ok[uniq_d])
+                if goals.size:
+                    goal = int(uniq_d[goals[0]])
+                    break
+                frontier = uniq_d.tolist()
+            if goal < 0:
+                break  # disconnected; annealer's job
+            src_member = np.zeros(B, dtype=bool)
+            src_member[list(srcs)] = True
+            node = goal
+            while not src_member[node]:
+                k = int(parent_edge[node])
+                swap(int(ep[k]), int(es[k]))
+                node = int(src_b[k])
+
+
+def greedy_seed(inst: ProblemInstance, max_repairs: int | None = None,
+                impl: str | None = None) -> np.ndarray:
+    """Greedy repair seed. ``impl`` overrides the process-wide
+    constructor implementation (``solvers.tpu.constructor``): ``"vec"``
+    (default) or ``"legacy"`` — the oracle the vectorized path is
+    parity-pinned against."""
     if max_repairs is None:
         max_repairs = 4 * int(inst.rf.sum()) + 64
-    r = _Repair(inst)
+    impl = impl or _constructor.active()
+    cls = _RepairVec if impl == "vec" else _Repair
+    r = cls(inst)
     r.fill_nulls()
     r.fix_diversity()
     r.fix_bands(max_repairs)

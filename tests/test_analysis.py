@@ -250,6 +250,56 @@ def test_kao107_metrics_help_type():
     assert "KAO107" not in _rules(_lint(NEG_107_PROSE))
 
 
+# ---------------------------------------------------------------- KAO109
+
+POS_109 = """
+    def weight_upper_bound(inst):
+        total = 0
+        for p in range(inst.num_parts):
+            total += int(inst.rf[p])
+        return total
+"""
+
+POS_109_SPLIT = """
+    def certify(inst):
+        P = inst.num_parts
+        acc = []
+        for p in range(P):
+            acc.append(p)
+        return acc
+"""
+
+NEG_109_VECTORIZED = """
+    import numpy as np
+
+    def weight_upper_bound(inst):
+        return int(inst.rf[: inst.num_parts].sum())
+"""
+
+
+def test_kao109_partition_loop_in_hot_modules():
+    # the rule is path-scoped to the bound/reseat hot modules
+    assert "KAO109" in _rules(_lint(POS_109, rel="models/bounds.py"))
+    assert "KAO109" in _rules(_lint(POS_109, rel="models/reseat.py"))
+    assert "KAO109" in _rules(
+        _lint(POS_109_SPLIT, rel="models/bounds.py")
+    )
+    assert "KAO109" not in _rules(
+        _lint(NEG_109_VECTORIZED, rel="models/bounds.py")
+    )
+    # other modules may loop (the engine's chunk loop, tests, CLI)
+    assert "KAO109" not in _rules(
+        _lint(POS_109, rel="solvers/tpu/engine.py")
+    )
+    # suppressible with justification, like every rule
+    sup = POS_109.replace(
+        "for p in range(inst.num_parts):",
+        "for p in range(inst.num_parts):  "
+        "# kao: disable=KAO109 -- cold fallback, never on the hot path",
+    )
+    assert _rules(_lint(sup, rel="models/bounds.py")) == []
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

@@ -1,0 +1,254 @@
+"""Vectorized-constructor parity pins (ISSUE 10, docs/CONSTRUCTOR.md).
+
+The host constructor path — greedy placement, the aggregated-MILP
+disaggregation, the flow/LP bounds assembly, and the exact leader
+reseat — was rewritten from per-partition Python loops into vectorized
+numpy behind the swappable implementation registry
+(``solvers.tpu.constructor``). The legacy path stays in the tree as the
+ORACLE; these tests pin the vectorized default against it on the demo,
+decommission, growth (rf_change), and adversarial fixtures:
+
+- greedy seeds are the SAME PLAN bit-for-bit (the vectorized repair
+  makes identical decisions by construction — same donor order, same
+  recipient lexsort, same BFS scan order);
+- the aggregated disaggregation realizes the same kept counts at the
+  same preservation weight (class partitions are exchangeable, so the
+  realizations may differ per partition but never in rank);
+- flow bounds are bit-equal across implementations;
+- the legacy path remains selectable (env + setter) and the solve
+  stats say which implementation served.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    demo_assignment,
+    demo_broker_list,
+    demo_topology,
+)
+from kafka_assignment_optimizer_tpu.models.instance import build_instance
+from kafka_assignment_optimizer_tpu.solvers.tpu import constructor
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+from kafka_assignment_optimizer_tpu.utils import gen
+
+FIXTURES = ("decommission", "rf_change", "adversarial", "scale_out",
+            "leader_only", "adv50k")
+
+
+def _fixture(name: str):
+    if name == "demo":
+        return build_instance(
+            demo_assignment(), demo_broker_list(), demo_topology()
+        )
+    sc = gen.SCENARIOS[name](**gen.SMOKE_KWARGS[name])
+    return build_instance(
+        sc.current, sc.broker_list, sc.topology,
+        target_rf=sc.kwargs.get("target_rf"),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _restore_impl():
+    prev = constructor.active()
+    yield
+    constructor.set_impl(prev)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_default_and_setter():
+    assert constructor.active() in constructor.IMPLS
+    prev = constructor.set_impl("legacy")
+    assert constructor.active() == "legacy"
+    constructor.set_impl(prev)
+    with pytest.raises(ValueError):
+        constructor.set_impl("typo")
+
+
+def test_solve_stats_name_the_implementation(demo):
+    from kafka_assignment_optimizer_tpu import optimize
+
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="tpu")
+    assert res.solve.stats["constructor_impl"] == constructor.active()
+
+
+# ---------------------------------------------------------- greedy seed
+
+
+@pytest.mark.parametrize("name", ("demo",) + FIXTURES)
+def test_greedy_seed_parity(name):
+    """Vectorized greedy == legacy greedy, plan-for-plan, on every
+    fixture family (same plan is the strongest rank tie) — and the
+    plan is oracle-verified feasible wherever the legacy one is."""
+    inst_l = _fixture(name)
+    inst_v = _fixture(name)
+    a_legacy = greedy_seed(inst_l, impl="legacy")
+    a_vec = greedy_seed(inst_v, impl="vec")
+    assert np.array_equal(a_legacy, a_vec), name
+    if inst_l.is_feasible(a_legacy):
+        assert inst_v.is_feasible(a_vec)
+
+
+def test_greedy_seed_parity_scrambled_growth(rng):
+    """A shuffled mixed-RF cluster under an RF bump: nulls, diversity,
+    band and leader repairs all fire — the adversarial composition for
+    the repair machinery — and the implementations still agree."""
+    sc = gen.adversarial(n_brokers=32, n_racks=4, n_topics_low=6,
+                         n_topics_high=6, parts_per_topic=10, seed=1)
+    kw = dict(target_rf=4)
+    inst_l = build_instance(sc.current, sc.broker_list, sc.topology, **kw)
+    inst_v = build_instance(sc.current, sc.broker_list, sc.topology, **kw)
+    a_legacy = greedy_seed(inst_l, impl="legacy")
+    a_vec = greedy_seed(inst_v, impl="vec")
+    assert np.array_equal(a_legacy, a_vec)
+
+
+# ------------------------------------------------------- disaggregation
+
+
+def test_disaggregate_parity_same_counts_and_weight():
+    """Both realizations of the aggregated MILP counts keep the same
+    number of slots at the same preservation weight (partitions within
+    a class are exchangeable, so per-partition choices may differ but
+    totals may not)."""
+    from kafka_assignment_optimizer_tpu.solvers.lp_round import (
+        _disaggregate,
+    )
+
+    inst = _fixture("decommission")
+    agg = inst._kept_weight_agg(integer=True, return_solution=True)
+    assert isinstance(agg, dict), "fixture no longer yields an aggregate"
+    out = {}
+    for impl in ("legacy", "vec"):
+        constructor.set_impl(impl)
+        d = _disaggregate(inst, agg)
+        assert d is not None
+        mr, mc = d["mrows"], d["mcols"]
+        wl = inst.w_leader[mr, mc]
+        wf = np.maximum(inst.w_follower[mr, mc], 0)
+        out[impl] = (
+            int(d["x"].sum()), int(d["y"].sum()),
+            int((wf * d["x"]).sum() + (wl * d["y"]).sum()),
+        )
+        # structural sanity: at most one kept leader per partition,
+        # never a member kept in both roles
+        assert not (d["x"] & d["y"]).any()
+        assert np.bincount(mr[d["y"]], minlength=inst.num_parts).max() <= 1
+    assert out["legacy"] == out["vec"]
+
+
+@pytest.mark.parametrize("name", ("scale_out", "leader_only",
+                                  "rf_change", "decommission"))
+def test_construct_parity_end_to_end(name):
+    """``lp_round.construct`` under both implementations: same
+    feasibility, same preservation weight, same move count — the
+    constructor-rank parity the engine's final selection relies on."""
+    from kafka_assignment_optimizer_tpu.solvers.lp_round import construct
+
+    out = {}
+    for impl in ("legacy", "vec"):
+        constructor.set_impl(impl)
+        inst = _fixture(name)  # fresh: no cross-impl memo sharing
+        plan = construct(inst)
+        assert plan is not None, (name, impl)
+        out[impl] = (
+            inst.is_feasible(plan),
+            inst.preservation_weight(plan),
+            inst.move_count(plan),
+            getattr(inst, "_agg_weight_ub", None),
+        )
+    assert out["legacy"] == out["vec"], name
+
+
+def test_lossless_lp_vertex_records_weight_bound():
+    """A losslessly realized kept-replica LP vertex records its weight
+    as a certificate bound (the ``_agg_weight_ub`` convention the
+    aggregated MILP already used) so certify_optimal needs no second
+    kept-LP solve — the ISSUE 10 duplicated-LP fix — and the recorded
+    bound really is an upper bound: certification still holds."""
+    from kafka_assignment_optimizer_tpu.solvers.lp_round import construct
+
+    inst = _fixture("scale_out")
+    plan = construct(inst)
+    assert plan is not None
+    ub = getattr(inst, "_agg_weight_ub", None)
+    assert ub is not None
+    assert inst.preservation_weight(plan) == ub
+    assert inst.certify_optimal(plan, allow_tight=False)
+
+
+# ---------------------------------------------------------- flow bounds
+
+
+@pytest.mark.parametrize("name", ("decommission", "scale_out",
+                                  "leader_only", "adversarial"))
+def test_flow_bounds_bit_equal_across_impls(name):
+    """The move/weight bound ladder is implementation-independent:
+    bit-equal integers whichever constructor impl is active (the
+    vectorized bounds assembly changed representation, not values)."""
+    vals = {}
+    for impl in ("legacy", "vec"):
+        constructor.set_impl(impl)
+        inst = _fixture(name)
+        vals[impl] = (
+            int(inst.move_lower_bound()),
+            int(inst.move_lower_bound_exact()),
+            int(inst.weight_upper_bound(level=0)),
+            int(inst.weight_upper_bound(level=1)),
+            int(inst.weight_upper_bound(level=2)),
+        )
+    assert vals["legacy"] == vals["vec"], name
+
+
+# --------------------------------------------------------------- reseat
+
+
+def test_reseat_racer_matches_lp_oracle():
+    """The reseat racer's exact leader assignment (cycle canceller)
+    still reaches the transportation-LP optimum on a scrambled-leader
+    plan — the reseat half of the constructor parity pin."""
+    inst = _fixture("leader_only")
+    a = greedy_seed(inst)
+    # scramble leaders: rotate each partition's slots so leader counts
+    # leave the band and the repair phase must run
+    rng = np.random.default_rng(3)
+    a = a.copy()
+    for p in range(inst.num_parts):
+        r = int(inst.rf[p])
+        if r > 1 and rng.random() < 0.5:
+            a[p, :r] = np.roll(a[p, :r], 1)
+    fast = inst.best_leader_assignment(a)
+    oracle = inst._best_leader_lp(a)
+    assert oracle is not None
+    assert inst.preservation_weight(fast) == \
+        inst.preservation_weight(oracle)
+    # the reseat permutes slots only: replica sets untouched
+    assert np.array_equal(np.sort(fast, axis=1), np.sort(a, axis=1))
+
+
+# ------------------------------------------------------------ env wiring
+
+
+def test_env_selects_legacy(monkeypatch):
+    """KAO_CONSTRUCTOR=legacy selects the oracle implementation in a
+    fresh process — the operator's no-redeploy fallback rung."""
+    import subprocess
+    import sys
+
+    code = (
+        "from kafka_assignment_optimizer_tpu.solvers.tpu import "
+        "constructor as c; print(c.active())"
+    )
+    env = {"KAO_CONSTRUCTOR": "legacy", "JAX_PLATFORMS": "cpu",
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    import os
+    env = {**os.environ, **env}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert out.stdout.strip() == "legacy"

@@ -134,10 +134,11 @@ def test_mcmf_completion_survives_binding_lead_gates():
         lead_quota=np.array([1, 0]),
     )
     assert out is not None
-    assert sorted((p, b) for p, b, _lead in out) == [(0, 0), (1, 0)]
+    ap, ab, alead = out  # flat assignment arrays (ISSUE 10)
+    assert sorted(zip(ap.tolist(), ab.tolist())) == [(0, 0), (1, 0)]
     # exactly one went through the rewarded lead channel; the other
     # took the cost-0 bypass (lead_quota[0] is 1)
-    assert sum(lead for _p, _b, lead in out) == 1
+    assert int(alead.sum()) == 1
 
 
 def test_engine_uses_constructed_plan():

@@ -225,6 +225,58 @@ def test_constructed_solve_trace_still_covers_every_phase(demo):
         assert _find(rep["spans"], "polish")["attrs"]["skipped"] is True
 
 
+def test_constructor_subphase_spans_and_histograms(demo):
+    """ISSUE 10 satellite: the constructor's host work is attributed to
+    sub-phase spans — bounds_flow (the flow/LP bound computation),
+    greedy / reseat (the racer's two loops), adopt (taking the
+    constructed plan) — which roll up into the report's phases dict and
+    the kao_phase_seconds histograms, so flight records and bench's
+    construct_host_s column can tell the vectorized loops apart from
+    overlap wait."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        solve_tpu,
+    )
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    # the default demo solve wins a constructor race: bounds_flow runs
+    # in the bounds worker, adopt on the main thread
+    current, brokers, topo = demo
+    res = optimize(current, brokers, topo, solver="tpu", trace=True)
+    rep = res.solve.stats["solve_report"]
+    assert _find(rep["spans"], "bounds_flow") is not None
+    assert _find(rep["spans"], "adopt") is not None
+    assert rep["phases"].get("bounds_flow", 0) >= 0
+    assert rep["phases"].get("adopt", 0) >= 0
+
+    # a slack-caps, symmetry-free instance above the exact-race size
+    # takes the greedy+reseat racer: its two loops get their own spans
+    sc = gen.adversarial(**gen.SMOKE_KWARGS["adversarial"])
+    inst = build_instance(sc.current, sc.broker_list, sc.topology)
+    # prewarm bounds so the racer certifies inside the race window
+    # deterministically even on a loaded machine
+    inst.move_lower_bound_exact()
+    inst.weight_upper_bound()
+    res2 = solve_tpu(inst, seed=0, trace=True)
+    rep2 = res2.stats["solve_report"]
+    assert _find(rep2["spans"], "greedy") is not None
+    assert _find(rep2["spans"], "reseat") is not None
+    # summed sub-phase seconds land in the phases dict (obs.trace
+    # SUB_PHASES roll-up) without disturbing the root-phase vocabulary
+    assert rep2["phases"]["greedy"] >= 0
+    assert rep2["phases"]["reseat"] >= 0
+    counts = Counter(_names(rep2["spans"]))
+    for ph in PHASES:
+        assert counts[ph] == 1, (ph, counts)
+    # and feed the kao_phase_seconds{phase=} histograms
+    snap = otrace.phase_snapshot()
+    for sub in ("bounds_flow", "greedy", "reseat", "adopt"):
+        assert sub in snap, (sub, sorted(snap))
+        assert snap[sub]["count"] >= 1
+
+
 def test_tracing_disabled_by_default(demo):
     current, brokers, topo = demo
     res = optimize(current, brokers, topo, solver="tpu", engine="chain",

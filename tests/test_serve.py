@@ -375,14 +375,25 @@ def test_healthz_cache_and_queue_sections(server_url):
     for key in ("bucket_hits", "bucket_misses", "exec_hits",
                 "exec_misses", "compiles_total", "compile_seconds_total"):
         assert key in cache
+    # lane consolidation (ISSUE 10): the active padding rungs plus
+    # executables reported by bucket with the raw batch widths served
+    assert isinstance(cache["lane_ladder"], list)
+    assert cache["lane_ladder"] == [] or cache["lane_ladder"][-1] >= 2
+    assert isinstance(cache["lane_executables"], dict)
+    for row in cache["lane_executables"].values():
+        assert set(row) >= {"lane_buckets", "served_lane_counts",
+                            "dispatches"}
     q = body["queue"]
     assert q["workers"] >= 1 and q["queue_depth"] >= 0
 
 
 def test_warmup_endpoint_precompiles_bucket(server_url):
-    """POST /warmup compiles a bucket's executables once; a second
-    warmup of the same bucket reports already_warm with zero compiles
-    (the acceptance signal: same-bucket solves never see XLA compile)."""
+    """POST /warmup compiles a bucket's executables once — including
+    the CONSOLIDATED lane-padded batch executable, once per bucket, not
+    once per lane count (ISSUE 10) — and a second warmup of the same
+    bucket reports already_warm with zero compiles on both rows (the
+    acceptance signal: same-bucket solves, batched at any width, never
+    see XLA compile)."""
     shape = {"brokers": 8, "partitions": 24, "rf": 2, "racks": 2}
     status, out = post_to(server_url, "/warmup",
                           {"shapes": [shape], "engine": "sweep"})
@@ -390,16 +401,28 @@ def test_warmup_endpoint_precompiles_bucket(server_url):
     row = out["warmed"][0]
     assert row["bucket_parts"] >= shape["partitions"]
     assert row["wall_s"] > 0
+    # lane warmup ran by default and reports its own compile delta
+    assert "lane_error" not in row, row
+    assert row["lane_bucket"] >= 2
+    assert row["lane_wall_s"] > 0
     status, out2 = post_to(server_url, "/warmup",
                            {"shapes": [shape], "engine": "sweep"})
     assert status == 200, out2
     row2 = out2["warmed"][0]
     assert row2["already_warm"] is True
     assert row2["compiles"] == 0 and row2["compile_s"] == 0
+    assert row2.get("lanes_already_warm") is True, row2
+    assert row2.get("lane_compiles") == 0, row2
+    # "lanes": false opts the lane precompile out (and stays fast)
+    status, out3 = post_to(server_url, "/warmup",
+                           {"shapes": [shape], "lanes": False})
+    assert status == 200, out3
+    assert "lane_bucket" not in out3["warmed"][0]
     # malformed warmup bodies are structured 400s
     for bad in ({}, {"shapes": []}, {"shapes": ["x"]},
                 {"shapes": [{"brokers": 2, "partitions": 4, "rf": 3}]},
-                {"shapes": [[8, 24]], "engine": "bogus"}):
+                {"shapes": [[8, 24]], "engine": "bogus"},
+                {"shapes": [[8, 24]], "lanes": "yes"}):
         status, body = post_to(server_url, "/warmup", bad)
         assert status == 400, (bad, body)
 
