@@ -194,7 +194,7 @@ def _env_stamp(platform: str, ndev: int | None, env: dict) -> dict:
 def _run_child(
     args: argparse.Namespace, name: str, env: dict, warmrun: bool,
     kernel: bool = False, batch_bench: bool = False,
-    replay_day: bool = False,
+    replay_day: bool = False, portfolio_bench: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -209,6 +209,8 @@ def _run_child(
         cmd.append("--batch-bench")
     if replay_day:
         cmd.append("--replay-day")
+    if portfolio_bench:
+        cmd.append("--portfolio-bench")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -522,6 +524,112 @@ def run_batch_throughput(smoke: bool, seed: int) -> dict:
     }
 
 
+def run_portfolio_ab(smoke: bool, seed: int) -> dict:
+    """Portfolio A/B (the PR-11 tentpole evidence, docs/PORTFOLIO.md):
+    the messy worst-case family (``gen.messy_case`` — irregular
+    topics/RFs, lopsided racks, exact bands; seed 1 is the instance
+    that was the tier-1 xfail) solved twice per case at EQUAL search
+    budget — portfolio OFF (one default config) vs portfolio ON (the
+    diverse lane table racing through the one lane-padded executable
+    per bucket). Scored on the deterministic signals: per-arm feasible
+    and certify counts, the worst case's violation count, summed
+    objective over feasible cases, and time-to-first-certificate for
+    early-exited solves. The exec-cache compile counters across the
+    portfolio arm pin the consolidation claim: every width shares the
+    bucket's one lane executable."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    import jax
+
+    from kafka_assignment_optimizer_tpu.api import optimize
+    from kafka_assignment_optimizer_tpu.solvers.tpu import bucket
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    cases = list(range(4 if smoke else 8))
+    # 16 sweeps is the discriminating budget: the single default config
+    # leaves the exact-band case (seed 1) infeasible while the
+    # portfolio's diverse lanes close it — at 32+ even the solo path
+    # eventually stumbles through on some hosts, washing out the A/B
+    rounds = 16
+    knobs = dict(engine="sweep", batch=8, rounds=rounds)
+
+    def arm(portfolio: bool) -> dict:
+        feasible = certified = early = 0
+        worst_viol = 0
+        obj_total = 0
+        walls, ttfc = [], []
+        for cs in cases:
+            current, brokers, topo, trf = gen.messy_case(cs)
+            t0 = time.perf_counter()
+            res = optimize(current, brokers, topo, target_rf=trf,
+                           solver="tpu", seed=seed + cs,
+                           portfolio=portfolio, **knobs)
+            walls.append(time.perf_counter() - t0)
+            rep = res.report()
+            viol = sum(rep["violations"].values())
+            worst_viol = max(worst_viol, viol)
+            if rep["feasible"]:
+                feasible += 1
+                obj_total += rep["objective_weight"]
+            if rep["proven_optimal"]:
+                certified += 1
+            port = res.solve.stats.get("portfolio") or {}
+            if port.get("early_exit"):
+                early += 1
+                if port.get("certified_at_s") is not None:
+                    ttfc.append(float(port["certified_at_s"]))
+        return {
+            "feasible": feasible,
+            "certified": certified,
+            "early_exit": early,
+            "worst_violations": worst_viol,
+            "objective_total": obj_total,
+            "wall_s_total": round(sum(walls), 3),
+            "wall_p50_s": _pctile(walls, 50),
+            "ttfc_p50_s": _pctile(ttfc, 50),
+        }
+
+    # warm EVERY case's executables in both arms before timing: the
+    # messy family varies broker/rack counts per seed, and those axes
+    # are exact in the bucket key (docs/BUCKETING.md) — warming only
+    # one case would leave the other timed rows paying XLA compiles,
+    # turning the latency columns into compile jitter and the
+    # compiles-per-arm consolidation evidence into noise
+    for cs in cases:
+        wc, wb, wt, wr = gen.messy_case(cs)
+        for port in (False, True):
+            optimize(wc, wb, wt, target_rf=wr, solver="tpu", seed=seed,
+                     portfolio=port, **knobs)
+    c0 = bucket.STATS.snapshot()
+    single = arm(False)
+    c1 = bucket.STATS.snapshot()
+    port = arm(True)
+    c2 = bucket.STATS.snapshot()
+    return {
+        "platform": jax.devices()[0].platform,
+        "cases": len(cases),
+        "rounds": rounds,
+        "single": single,
+        "portfolio": port,
+        # the PR's quality claim, as one deterministic bit: at equal
+        # budget the portfolio's worst case is no worse and it closes
+        # at least as many cases
+        "quality_win": (
+            port["worst_violations"] <= single["worst_violations"]
+            and port["feasible"] >= single["feasible"]
+        ),
+        # consolidation evidence: the portfolio arm's timed cases run
+        # on the executables the warmup row compiled — zero compiles
+        "compiles_single_arm": (
+            c1["compiles_total"] - c0["compiles_total"]
+        ),
+        "compiles_portfolio_arm": (
+            c2["compiles_total"] - c1["compiles_total"]
+        ),
+    }
+
+
 def _pctile(xs: list, q: float) -> float | None:
     """Nearest-rank percentile of a small latency sample."""
     if not xs:
@@ -800,6 +908,10 @@ def child_main(args: argparse.Namespace) -> int:
         out = run_batch_throughput(args.smoke, args.seed)
         print("RESULT " + json.dumps(out))
         return 0
+    if args.portfolio_bench:
+        out = run_portfolio_ab(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
     out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
     if args.kernel:
         try:
@@ -889,6 +1001,31 @@ def _compact_replay(rb: dict | None, err: str | None) -> dict:
     }
 
 
+def _compact_portfolio(rp: dict | None, err: str | None) -> dict:
+    """The portfolio_ab block of the stdout line: the deterministic
+    quality verdict, both arms' feasible/certify counts and worst-case
+    violations, first-certificate latency, and the compile counters
+    that pin the one-executable-per-bucket consolidation."""
+    if rp is None:
+        return {"error": (err or "failed")[:120]}
+    s, p = rp["single"], rp["portfolio"]
+    return {
+        "cases": rp["cases"],
+        "quality_win": rp["quality_win"],
+        "feasible_single": s["feasible"],
+        "feasible_portfolio": p["feasible"],
+        "certified_single": s["certified"],
+        "certified_portfolio": p["certified"],
+        "worst_viol_single": s["worst_violations"],
+        "worst_viol_portfolio": p["worst_violations"],
+        "early_exit": p["early_exit"],
+        "ttfc_p50_s": p["ttfc_p50_s"],
+        "wall_p50_single_s": s["wall_p50_s"],
+        "wall_p50_portfolio_s": p["wall_p50_s"],
+        "compiles_portfolio_arm": rp["compiles_portfolio_arm"],
+    }
+
+
 def _compact_kernel(k: dict) -> dict:
     """3-6 scalars from the kernel micro-bench; the full block (roofline
     models, propose timings) goes to stderr with the rest of the detail."""
@@ -946,6 +1083,7 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          bucket_reuse: dict | None = None,
          batch_throughput: dict | None = None,
          replay_day: dict | None = None,
+         portfolio_ab: dict | None = None,
          env_stamp: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
@@ -1042,6 +1180,10 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # one scripted day — p50/p99 latency split, per-event quality,
         # storm coalescing with zero drops (docs/WATCH.md)
         line["replay_day"] = replay_day
+    if portfolio_ab:
+        # portfolio A/B: worst-case quality at equal budget,
+        # portfolio-on vs single-config (docs/PORTFOLIO.md)
+        line["portfolio_ab"] = portfolio_ab
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)
@@ -1088,6 +1230,17 @@ def main() -> int:
                     help="also run the batched-lane throughput scenario "
                          "(B in {1,2,4,8} same-bucket instances; "
                          "auto-enabled with --all)")
+    ap.add_argument("--portfolio-bench", action="store_true",
+                    help="run ONLY the portfolio A/B scenario "
+                         "(docs/PORTFOLIO.md): the messy worst-case "
+                         "family, portfolio-on vs single-config at "
+                         "equal budget — quality delta, certify rate, "
+                         "time-to-first-certificate, exec-cache "
+                         "compile counters — emitted as a one-line "
+                         "portfolio_ab artifact (the soak cold-path "
+                         "step's entry; same exclusive convention as "
+                         "--replay-day). The full default sweep runs "
+                         "the same harness automatically as an extra.")
     ap.add_argument("--replay-day", action="store_true",
                     help="run ONLY the event-day replay harness "
                          "(docs/WATCH.md): a scripted day of cluster "
@@ -1127,6 +1280,28 @@ def main() -> int:
         line = {"metric": "replay_day", "platform": platform,
                 "env": _env_stamp(platform, ndev, env),
                 **_compact_replay(rb, eb)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
+
+    if args.portfolio_bench:
+        # standalone portfolio A/B (the soak cold-path step's entry):
+        # one child, one dedicated stdout line — no scenario sweep.
+        # The full --all sweep runs the same harness as an extra.
+        try:
+            env, platform, tpu_err, ndev = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "portfolio_ab",
+                              "error": repr(e)[:300]}))
+            return 0
+        rp, ep = _run_child(args, "portfolio_ab", env, warmrun=False,
+                            portfolio_bench=True)
+        if rp is not None:
+            print("[bench] PORTFOLIO " + json.dumps(rp), file=sys.stderr)
+        line = {"metric": "portfolio_ab", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
+                "portfolio_ab": _compact_portfolio(rp, ep)}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
         print(json.dumps(line))
@@ -1275,6 +1450,17 @@ def main() -> int:
             print("[bench] REPLAY " + json.dumps(rr), file=sys.stderr)
         replay_day = _compact_replay(rr, er)
 
+    portfolio_ab: dict | None = None
+    if extras:
+        # the portfolio A/B (PR-11 tentpole evidence): worst-case
+        # quality at equal budget, portfolio-on vs single-config,
+        # compacted to the quality/certify/ttfc verdict for stdout
+        rp, ep = _run_child(args, "portfolio_ab", env, warmrun=False,
+                            portfolio_bench=True)
+        if rp is not None:
+            print("[bench] PORTFOLIO " + json.dumps(rp), file=sys.stderr)
+        portfolio_ab = _compact_portfolio(rp, ep)
+
     batch_throughput: dict | None = None
     if extras or args.batch_bench:
         # the batched-lane throughput scenario (PR-2 tentpole evidence):
@@ -1298,7 +1484,7 @@ def main() -> int:
          cold_cached=cold_cached,
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
          bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
-         replay_day=replay_day,
+         replay_day=replay_day, portfolio_ab=portfolio_ab,
          env_stamp=_env_stamp(platform, ndev, env))
     return 0
 

@@ -39,6 +39,17 @@ caught only dynamically, alignment- or platform-dependently):
   name bound from it) regressing into one of them is almost always a
   multi-second host stall at the 50k-partition scale. Suppressible
   with justification for genuine cold fallbacks.
+- **KAO110** lane-config values captured as Python scalars inside
+  ``make_*`` solver-factory bodies: the portfolio contract
+  (docs/PORTFOLIO.md) is that per-lane config — penalty scale,
+  temperature multiplier, move-set gates — is ARRAY DATA on the model
+  (``ModelArrays.lam``/``temp_scale``/``comp_enable``), so one
+  lane-padded executable per bucket serves every config. A config
+  name closed over by a factory's nested (traced) function — or a
+  ``float()``/``int()`` coercion of a config attribute inside the
+  factory — bakes the value into the jaxpr and silently
+  re-specializes the consolidated executable per config: the exact
+  compile-count regression PR 11 exists to prevent.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -147,6 +158,7 @@ def lint_source(
     out += _rule_traced_branch(tree, path)
     out += _rule_chaos_in_traced(tree, path)
     out += _rule_partition_loop(tree, path, rel)
+    out += _rule_lane_config_capture(tree, path)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -600,6 +612,107 @@ def _rule_partition_loop(tree, path, rel) -> list[Finding]:
                 "critical path — vectorize over the padded arrays "
                 "(docs/CONSTRUCTOR.md) or suppress with justification "
                 "for a genuine cold fallback"))
+    return out
+
+
+def _bound_names(fn) -> set[str]:
+    """Names a function binds itself: parameters plus own-scope stores
+    (nested defs excluded — they have their own scopes)."""
+    names = {
+        a.arg for a in (
+            fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        )
+    }
+    if fn.args.vararg:
+        names.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.add(fn.args.kwarg.arg)
+    for node in _walk_own_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+    return names
+
+
+# ---------------------------------------------------------------- KAO110
+
+# the lane-config vocabulary (arrays.LaneConfig / the ModelArrays
+# config leaves): values under these names are per-lane search config
+# and must reach traced bodies as MODEL DATA, never as captured Python
+# scalars (docs/PORTFOLIO.md)
+_LANE_CONFIG_NAMES = {
+    "lam", "lambda_", "temp_scale", "comp_enable", "lane_config",
+}
+_LANE_CONFIG_ATTRS = {"lam", "temp_scale", "comp_enable"}
+_SCALAR_COERCERS = {"float", "int", "bool"}
+
+
+def _rule_lane_config_capture(tree, path) -> list[Finding]:
+    """Flag lane-config values materialized as Python scalars inside
+    ``make_*`` solver-factory bodies. Two shapes:
+
+    - a nested def (the function the factory returns for jit/vmap
+      hosting) reading a config-named value from the FACTORY scope —
+      a closure capture, i.e. a compile-time constant per config;
+    - ``float(x.lam)`` / ``int(cfg.temp_scale)``-style coercions of a
+      config attribute anywhere in the factory body (the value can
+      only flow onward as a trace-time constant).
+
+    Both silently re-specialize the consolidated lane executable per
+    config; thread the value as model data instead
+    (``ModelArrays.lam`` — docs/PORTFOLIO.md)."""
+    out = []
+    seen: set[int] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not fn.name.lstrip("_").startswith("make"):
+            continue
+        factory_cfg = _bound_names(fn) & _LANE_CONFIG_NAMES
+        for inner in ast.walk(fn):
+            if inner is fn or not isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            shadowed = _bound_names(inner)
+            for node in ast.walk(inner):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in factory_cfg
+                    and node.id not in shadowed
+                    and node.lineno not in seen
+                ):
+                    seen.add(node.lineno)
+                    out.append(Finding(
+                        "KAO110", path, node.lineno,
+                        f"lane-config value '{node.id}' captured from "
+                        f"the enclosing {fn.name}() factory scope: it "
+                        "bakes into the traced executable and "
+                        "re-specializes the consolidated lane "
+                        "executable per config; thread it as model "
+                        "data (ModelArrays.lam/temp_scale/"
+                        "comp_enable — docs/PORTFOLIO.md)"))
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _SCALAR_COERCERS
+                and node.args
+                and isinstance(node.args[0], ast.Attribute)
+                and node.args[0].attr in _LANE_CONFIG_ATTRS
+                and node.lineno not in seen
+            ):
+                seen.add(node.lineno)
+                out.append(Finding(
+                    "KAO110", path, node.lineno,
+                    f"{node.func.id}(...{node.args[0].attr}) inside "
+                    f"{fn.name}(): coercing a lane-config attribute "
+                    "to a Python scalar makes it a trace-time "
+                    "constant and re-specializes the consolidated "
+                    "executable per config; keep it a device scalar "
+                    "(docs/PORTFOLIO.md)"))
     return out
 
 

@@ -61,6 +61,11 @@ class OptimizeResult:
             # a degraded plan that looks searched is an operator trap
             **({"degradations": list(self.solve.stats["degradations"])}
                if self.solve.stats.get("degradations") else {}),
+            # portfolio winner provenance (docs/PORTFOLIO.md): a dict,
+            # so the scalar fold above drops it — but which lane config
+            # produced the plan belongs on the serving surface
+            **({"solver_portfolio": dict(self.solve.stats["portfolio"])}
+               if self.solve.stats.get("portfolio") else {}),
         }
 
 

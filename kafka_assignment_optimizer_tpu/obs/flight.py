@@ -460,6 +460,11 @@ def record_solve(result, inst=None, acc: _SolveAcc | None = None,
                 "degraded": bool(st.get("degraded")),
             },
         }
+        if st.get("portfolio"):
+            # winner-lane provenance (docs/PORTFOLIO.md): which lane
+            # config produced the plan, whether a first-to-certify
+            # boundary retired the ladder, and when
+            rec["portfolio"] = dict(st["portfolio"])
         for key, v in {**ctx, **(extra or {})}.items():
             if key != "kind" and key not in rec:
                 rec[key] = v

@@ -207,6 +207,11 @@ def _latency_pairs(old: dict, new: dict) -> list[tuple[str, float, float]]:
     ord_, nrd = old.get("replay_day") or {}, new.get("replay_day") or {}
     for k in ("warm_p50_s", "warm_p99_s", "cold_p50_s", "cold_p99_s"):
         add(f"replay_day.{k}", ord_.get(k), nrd.get(k))
+    opa, npa = old.get("portfolio_ab") or {}, \
+        new.get("portfolio_ab") or {}
+    for k in ("ttfc_p50_s", "wall_p50_single_s",
+              "wall_p50_portfolio_s"):
+        add(f"portfolio_ab.{k}", opa.get(k), npa.get(k))
     return pairs
 
 
@@ -275,6 +280,25 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
         if obt.get(k) is True and nbt.get(k) is False:
             regs.append({"metric": f"batch.{k}",
                          "old": True, "new": False})
+    # portfolio A/B quality (docs/PORTFOLIO.md): the worst-case-quality
+    # win, the per-arm feasible counts, and the worst case's violation
+    # count are all deterministic signals — any backslide is confirmed
+    opa, npa = old.get("portfolio_ab") or {}, \
+        new.get("portfolio_ab") or {}
+    if opa.get("quality_win") is True and npa.get("quality_win") is False:
+        regs.append({"metric": "portfolio_ab.quality_win",
+                     "old": True, "new": False})
+    of, nf = opa.get("feasible_portfolio"), npa.get("feasible_portfolio")
+    if (isinstance(of, (int, float)) and isinstance(nf, (int, float))
+            and nf < of):
+        regs.append({"metric": "portfolio_ab.feasible_portfolio",
+                     "old": of, "new": nf})
+    ow, nw = opa.get("worst_viol_portfolio"), \
+        npa.get("worst_viol_portfolio")
+    if (isinstance(ow, (int, float)) and isinstance(nw, (int, float))
+            and nw > ow):
+        regs.append({"metric": "portfolio_ab.worst_viol_portfolio",
+                     "old": ow, "new": nw})
     return regs
 
 
@@ -399,6 +423,11 @@ def seed_slowdown(artifact: dict, factor: float) -> dict:
     if isinstance(bt, dict):
         for k in ("b1", "b2", "b4", "b8"):
             scale(bt, k, 1.0 / f)
+    pa = art.get("portfolio_ab")
+    if isinstance(pa, dict):
+        for k in ("ttfc_p50_s", "wall_p50_single_s",
+                  "wall_p50_portfolio_s"):
+            scale(pa, k, f)
     return art
 
 

@@ -39,7 +39,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..solvers.tpu.arrays import (
-    LAMBDA,
     SCALE_W,
     ModelArrays,
     band_pen as _band,
@@ -77,7 +76,7 @@ def _propose_kernel(
     rlo_ref,     # [K1, 1] int32
     rhi_ref,     # [K1, 1] int32
     lim_ref,     # [1, 4] int32 (broker_lo, broker_hi, leader_lo, leader_hi)
-    temp_ref,    # [1, 1] float32
+    temp_ref,    # [1, 2] float32 (temp, lam) — per-lane config is DATA
     bits_ref,    # [1, 8, TP] uint32
     cnt_ref,     # [B1, N] int32 broker histograms, all chains (full block:
                  # Mosaic forbids 1-lane column blocks; the kernel selects
@@ -243,7 +242,10 @@ def _propose_kernel(
         ),
         rf > 0,
     )
-    delta = (SCALE_W * dw - LAMBDA * dpen).astype(f32)
+    # penalty scale as data (mirrors sweep.propose_site bit-for-bit:
+    # the int deltas are exact in float32, < 2^24)
+    lam = temp_ref[0, 1]
+    delta = (SCALE_W * dw).astype(f32) - lam * dpen.astype(f32)
 
     # ---- Metropolis accept + thinning priority -----------------------
     temp = temp_ref[0, 0]
@@ -302,8 +304,8 @@ _LW = 128  # lane width of the in-kernel map accumulators
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
-                  rackof, rlo, rhi, lim, *, interpret: bool):
+def _propose_call(a, bits, cnt, lcnt, rcnt, temp, lam, a0, rf, prh, wl,
+                  wf, rackof, rlo, rhi, lim, *, interpret: bool):
     N, P, R = a.shape
     B1 = wl.shape[0]
     K1 = rlo.shape[0]
@@ -319,7 +321,11 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
     cntT = jnp.swapaxes(cnt, 0, 1)                            # [B1, N]
     lcntT = jnp.swapaxes(lcnt, 0, 1)
     rcntT = jnp.swapaxes(rcnt, 0, 1)                          # [K1, N]
-    temp_a = jnp.full((1, 1), temp, jnp.float32)
+    # (temp, lam) ride one [1, 2] f32 operand: per-lane config is data,
+    # so every config shares this executable (docs/PORTFOLIO.md)
+    temp_a = jnp.stack(
+        [jnp.asarray(temp, jnp.float32), jnp.asarray(lam, jnp.float32)]
+    )[None, :]
 
     Pp = aT.shape[-1]
     pval = (jnp.arange(Pp, dtype=jnp.int32) < P).astype(jnp.int32)[None]
@@ -341,7 +347,7 @@ def _propose_call(a, bits, cnt, lcnt, rcnt, temp, a0, rf, prh, wl, wf,
             pl.BlockSpec((K1, 1), lambda n, p: (0, 0), memory_space=vm),
             pl.BlockSpec((K1, 1), lambda n, p: (0, 0), memory_space=vm),
             pl.BlockSpec((1, 4), lambda n, p: (0, 0), memory_space=vm),
-            pl.BlockSpec((1, 1), lambda n, p: (0, 0), memory_space=vm),
+            pl.BlockSpec((1, 2), lambda n, p: (0, 0), memory_space=vm),
             pl.BlockSpec((1, 8, tp), lambda n, p: (n, 0, p), memory_space=vm),
             # full-array blocks: Mosaic forbids 1-lane column blocks, so
             # every chain's histogram column rides along and the kernel
@@ -394,7 +400,7 @@ def propose_site_pallas(m: ModelArrays, a: jax.Array, bits: jax.Array,
         jnp.int32
     )[None]
     islsw, s, bnew, blead, bats, prio, _pad, _mo, _mi = _propose_call(
-        a, bits, cnt, lcnt, rcnt, temp,
+        a, bits, cnt, lcnt, rcnt, temp, m.lam,
         m.a0, m.rf, m.part_rack_hi.astype(jnp.int32),
         jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
         jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),

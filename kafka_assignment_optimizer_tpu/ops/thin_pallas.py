@@ -223,7 +223,7 @@ def site_step_pallas(m: ModelArrays, a: jax.Array, cnt, lcnt, rcnt,
     rackof = m.rack_of.astype(jnp.int32)[:, None]
     K1 = m.rack_lo.shape[0]
     *_recs, padded, m_out, m_in = _propose_call(
-        a, bits, cnt, lcnt, rcnt, temp,
+        a, bits, cnt, lcnt, rcnt, temp, m.lam,
         m.a0, m.rf, m.part_rack_hi.astype(jnp.int32),
         jnp.swapaxes(m.w_lead.astype(jnp.int32), 0, 1),
         jnp.swapaxes(m.w_foll.astype(jnp.int32), 0, 1),

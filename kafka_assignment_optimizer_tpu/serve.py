@@ -159,6 +159,7 @@ MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
 ALLOWED_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
     "time_limit_s", "t_hi", "t_lo", "n_devices", "pipeline",
+    "portfolio",
 })
 
 # saturation policy: how long a request waits for a queue slot before
@@ -224,7 +225,7 @@ DEFAULT_MAX_BATCH = 8
 # other knob (e.g. steps_per_round) takes the single-solve path
 _BATCHABLE_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "engine", "time_limit_s",
-    "t_hi", "t_lo", "n_devices", "pipeline",
+    "t_hi", "t_lo", "n_devices", "pipeline", "portfolio",
 })
 # executable-accumulation hygiene: drop in-process jit caches after this
 # many completed solves (see _SolveQueue._maintenance)
@@ -611,7 +612,16 @@ _METRICS = {
     "batch_lanes_feasible_total": 0,     # per-lane quality counters
     "batch_lane_moves_total": 0,
     "batch_lane_weight_total": 0,
+    # portfolio lanes (docs/PORTFOLIO.md): single-path solves that
+    # raced a config portfolio, and how many retired the ladder on a
+    # first-to-certify boundary certificate
+    "portfolio_solves_total": 0,
+    "portfolio_early_exit_total": 0,
 }
+# portfolio winner-lane histogram (rendered as the labeled counter
+# family kao_portfolio_winner_total{lane="N"}): which configs actually
+# win is the evidence the diversity table earns its lanes
+_PORTFOLIO_WINNERS: dict[int, int] = {}
 # batch-size histogram: coalesced dispatch size -> count (rendered as
 # the labeled counter family kao_batch_size_total{size="N"})
 _BATCH_SIZES: dict[int, int] = {}
@@ -749,6 +759,21 @@ def render_metrics() -> str:
         sizes = dict(_BATCH_SIZES)
         sheds = {r: 0 for r in _SHED_REASON_NAMES}
         sheds.update(_SHED_REASONS)
+        port_winners = dict(_PORTFOLIO_WINNERS)
+    # portfolio geometry gauge: the width a defaulted solve races now
+    # (0-vs-N is the --no-portfolio toggle made scrapeable). Read ONLY
+    # from an already-imported engine module — a /metrics scrape must
+    # never be the thing that pays the engine's jax import (same
+    # invariant as the _BUILD_INFO cache); the gauge appears after the
+    # first solve or health probe, like kao_build_info's labels.
+    eng = sys.modules.get(
+        __name__.rsplit(".", 1)[0] + ".solvers.tpu.engine"
+    )
+    if eng is not None:
+        try:
+            snap["portfolio_width"] = eng.portfolio_width_default()
+        except Exception:
+            pass
     # executable/bucket cache counters (solvers.tpu.bucket.STATS): the
     # operational evidence that shape bucketing is absorbing compiles —
     # kao_cache_exec_hits climbing while kao_cache_compiles_total stays
@@ -825,6 +850,17 @@ def render_metrics() -> str:
     for size in sorted(sizes):
         lines.append(
             f'kao_batch_size_total{{size="{size}"}} {sizes[size]}'
+        )
+    # portfolio winner-lane histogram (docs/PORTFOLIO.md): which lane
+    # configs actually win solves — a lane that never wins is a slot
+    # the diversity table should respend
+    lines.append("# HELP kao_portfolio_winner_total portfolio solves "
+                 "won, by winning lane index")
+    lines.append("# TYPE kao_portfolio_winner_total counter")
+    for lane in sorted(port_winners):
+        lines.append(
+            f'kao_portfolio_winner_total{{lane="{lane}"}} '
+            f"{port_winners[lane]}"
         )
     # load sheds by reason: every 503 names why it shed, and the full
     # reason set is pre-declared at zero so dashboards can alert on
@@ -1298,6 +1334,13 @@ def handle_submit(
         options["pipeline"], bool
     ):
         raise ApiError(400, "'pipeline' must be a boolean")
+    # portfolio lanes (docs/PORTFOLIO.md): bool only — the width is an
+    # operator knob (KAO_PORTFOLIO_WIDTH), never a per-request one (a
+    # client naming an arbitrary width could multiply device work)
+    if "portfolio" in options and not isinstance(
+        options["portfolio"], bool
+    ):
+        raise ApiError(400, "'portfolio' must be a boolean")
     if max_solve_s is not None:
         # cap every solve: client may tighten the limit but not exceed it
         options["time_limit_s"] = (
@@ -1476,10 +1519,20 @@ def handle_submit(
                             solver=solver, error=repr(e)[:200])
                 raise
             dt = time.perf_counter() - t0
+            port = res.solve.stats.get("portfolio") or None
             with _METRICS_LOCK:
                 _METRICS["solves_total"] += 1
                 _METRICS["solve_seconds_total"] += dt
                 _METRICS["last_solve_seconds"] = dt
+                if port:
+                    _METRICS["portfolio_solves_total"] += 1
+                    if port.get("early_exit"):
+                        _METRICS["portfolio_early_exit_total"] += 1
+                    wl = port.get("winner_lane")
+                    if wl is not None:
+                        _PORTFOLIO_WINNERS[int(wl)] = (
+                            _PORTFOLIO_WINNERS.get(int(wl), 0) + 1
+                        )
             rep = res.report()
             if tr is not None:
                 tr.root.set(solver=res.solve.solver,
@@ -1784,6 +1837,10 @@ def handle_healthz() -> dict:
             "window_ms": round(_COALESCER.window_s * 1e3, 3),
             "max_batch": _COALESCER.max_batch,
         },
+        # portfolio lanes (docs/PORTFOLIO.md): what a defaulted
+        # single-path sweep solve races right now — width 1 means
+        # --no-portfolio (or KAO_NO_PORTFOLIO) turned racing off
+        "portfolio": _healthz_portfolio(),
         "observability": {
             "trace_enabled": bool(OBS["trace"]),
             "solve_reports_held": len(_otrace.RECENT.ids()),
@@ -1809,6 +1866,28 @@ def handle_healthz() -> dict:
             "queue_wait_s": _SOLVES.queue_wait_s,
         },
         "watch": _healthz_watch(),
+    }
+
+
+def _healthz_portfolio() -> dict:
+    """The /healthz portfolio section: effective default width, the
+    lane-padded dispatch width it maps to (shared with the coalescing
+    batch path — one executable per bucket), and the config table the
+    lanes race."""
+    import dataclasses as _dc
+
+    from .solvers.tpu import bucket
+    from .solvers.tpu.arrays import portfolio_configs
+    from .solvers.tpu.engine import portfolio_width_default
+
+    width = portfolio_width_default()
+    return {
+        "enabled": width > 1,
+        "width": width,
+        "lane_bucket": bucket.lane_bucket(width),
+        "configs": [
+            _dc.asdict(c) for c in portfolio_configs(width)
+        ] if width > 1 else [],
     }
 
 
@@ -1946,6 +2025,13 @@ def handle_warmup(
     warm_lanes = payload.get("lanes", True)
     if not isinstance(warm_lanes, bool):
         raise ApiError(400, "warmup 'lanes' must be a boolean")
+    # portfolio warmup (docs/PORTFOLIO.md): unless "portfolio": false,
+    # each shape also runs one portfolio-enabled precompile solve so
+    # the portfolio-width lane executable — with the SINGLE-solve
+    # path's chunk schedule — is warm before traffic races it
+    warm_portfolio = payload.get("portfolio", True)
+    if not isinstance(warm_portfolio, bool):
+        raise ApiError(400, "warmup 'portfolio' must be a boolean")
     parsed = [_parse_warmup_shape(sh) for sh in shapes]
 
     from .solvers.tpu import bucket
@@ -1998,8 +2084,60 @@ def handle_warmup(
                 current, broker_list, topo, engine, max_solve_s,
                 lock_wait_s,
             ))
+        if warm_portfolio and engine == "sweep":
+            row.update(_warmup_portfolio(
+                current, broker_list, topo, max_solve_s, lock_wait_s,
+            ))
         results.append(row)
     return {"warmed": results, "cache": bucket.STATS.snapshot()}
+
+
+def _warmup_portfolio(current, broker_list, topo,
+                      max_solve_s: float | None,
+                      lock_wait_s: float) -> dict:
+    """Precompile the portfolio-width lane executable for one warmup
+    shape: a single precompile solve with ``portfolio=True`` races the
+    full config table through the lane-padded dispatch the production
+    single-solve path uses — the chunk schedule (and with it the
+    executable identity) matches what real portfolio traffic sends.
+    Best-effort like the lane warmup; width 1 (portfolio disabled
+    process-wide) is a cheap no-op row."""
+    from .solvers.tpu import bucket
+    from .solvers.tpu.engine import portfolio_width_default
+
+    width = portfolio_width_default()
+    if width <= 1:
+        return {"portfolio_width": 1}
+
+    def _job():
+        t0 = time.perf_counter()
+        options: dict = {"engine": "sweep", "seed": 0,
+                         "precompile": True, "portfolio": True}
+        if max_solve_s is not None:
+            options["time_limit_s"] = max_solve_s
+        optimize(current, broker_list, topo, solver="tpu", **options)
+        return time.perf_counter() - t0
+
+    before = bucket.STATS.snapshot()
+    try:
+        wall = _SOLVES.submit(
+            _job, wait_s=lock_wait_s, budget_s=max_solve_s
+        )
+    except Exception as e:  # best-effort: the single-path row stands
+        _olog.warn("warmup_portfolio_failed", error=repr(e)[:200])
+        return {"portfolio_error": repr(e)[:200]}
+    after = bucket.STATS.snapshot()
+    return {
+        "portfolio_width": width,
+        "portfolio_lane_bucket": bucket.lane_bucket(width),
+        "portfolio_compiles": (
+            after["compiles_total"] - before["compiles_total"]
+        ),
+        "portfolio_wall_s": round(wall, 3),
+        "portfolio_already_warm": (
+            after["compiles_total"] == before["compiles_total"]
+        ),
+    }
 
 
 def _warmup_lanes(current, broker_list, topo, engine: str,
@@ -2358,6 +2496,10 @@ def main(argv: list[str] | None = None) -> int:
                          "for every solve this service runs "
                          "(docs/PIPELINE.md); clients may still opt a "
                          "request back in with options.pipeline=true")
+    ap.add_argument("--no-portfolio", action="store_true",
+                    help="disable portfolio lane racing by default "
+                         "(docs/PORTFOLIO.md); clients may still opt a "
+                         "request back in with options.portfolio=true")
     ap.add_argument("--no-trace", action="store_true",
                     help="disable per-request solve traces (responses "
                          "then carry no trace_id and /debug/solves "
@@ -2506,6 +2648,10 @@ def main(argv: list[str] | None = None) -> int:
         from .solvers.tpu.engine import set_pipeline_default
 
         set_pipeline_default(False)
+    if args.no_portfolio:
+        from .solvers.tpu.engine import set_portfolio_default
+
+        set_portfolio_default(False)
     OBS["trace"] = not args.no_trace
     OBS["profile_dir"] = args.profile_dir
     OBS["profile_solves"] = args.profile_solves

@@ -250,7 +250,12 @@ def _anneal_step(
     ).astype(i32)
 
     # --- accept -------------------------------------------------------
-    delta = (SCALE_W * dw - LAMBDA * dpen).astype(jnp.float32)
+    # penalty scale as data (m.lam, docs/PORTFOLIO.md): exact in
+    # float32 for the default config — bit-identical to the historical
+    # int `SCALE_W*dw - LAMBDA*dpen`
+    delta = (SCALE_W * dw).astype(jnp.float32) - m.lam * dpen.astype(
+        jnp.float32
+    )
     accept = jnp.logical_and(
         valid,
         jnp.logical_or(
@@ -310,6 +315,9 @@ def make_round_runner(steps_per_round: int, axis_name: str | None):
     def one_chain_steps(
         m: ModelArrays, st: ChainState, temp: jax.Array
     ) -> ChainState:
+        # per-lane ladder scaling as data (docs/PORTFOLIO.md); exact
+        # identity for the default config (x * 1.0 in float32)
+        temp = temp * m.temp_scale
         key, sub = random.split(st.key)
         bits = random.bits(sub, (steps_per_round, 8), jnp.uint32)
 

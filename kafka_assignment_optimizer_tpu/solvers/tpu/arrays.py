@@ -14,6 +14,7 @@ high-temperature sweeps tunnel through infeasible states.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 
 import jax
@@ -25,6 +26,57 @@ from ...models.instance import ProblemInstance
 # score = SCALE_W * weight - LAMBDA * total_violations
 SCALE_W = 1
 LAMBDA = 64
+
+
+@dataclass(frozen=True)
+class LaneConfig:
+    """One lane's search configuration (docs/PORTFOLIO.md) — the host
+    grammar for the per-lane config DATA the solver executables consume
+    (``ModelArrays.lam`` / ``temp_scale`` / ``comp_enable``). Config is
+    array data, never a compile-time constant: every config shares one
+    lane-padded executable per bucket (the KAO110 contract — a config
+    captured as a Python scalar in a ``make_*`` factory body would
+    silently re-specialize the executable per config).
+
+    - ``lam``: penalty scale. The default (``LAMBDA`` = 64) orders
+      feasibility strictly above preservation; low-``lam`` lanes tunnel
+      through penalized intermediate states tight bands otherwise
+      freeze out.
+    - ``temp_scale``: multiplier on the shared temperature ladder —
+      lanes anneal the same schedule hotter or colder.
+    - ``compound``: whether the lane ACCEPTS compound 2-move exchange
+      proposals (the sweeps still run lane-invariantly; a disabled
+      lane rejects them, keeping the one-executable contract).
+    """
+
+    lam: float = float(LAMBDA)
+    temp_scale: float = 1.0
+    compound: bool = True
+
+
+DEFAULT_CONFIG = LaneConfig()
+
+# the portfolio ladder (docs/PORTFOLIO.md): lane 0 is ALWAYS the
+# default config — a portfolio can never do worse than the solo solve
+# it replaces — and the rest trade penalty scale against temperature so
+# at least one lane can cross whichever barrier froze the others out.
+PORTFOLIO_TABLE = (
+    DEFAULT_CONFIG,                        # anchor: the solo config
+    LaneConfig(lam=8.0),                   # tunneler: cheap violations
+    LaneConfig(temp_scale=4.0),            # hot ladder: wide exploration
+    LaneConfig(lam=256.0, temp_scale=0.5),  # strict quench
+    LaneConfig(lam=4.0, temp_scale=2.0),   # hot + soft
+    LaneConfig(compound=False),            # plain move set (pre-PR-11)
+    LaneConfig(lam=16.0, temp_scale=0.25),  # near-greedy cold descent
+    LaneConfig(lam=128.0, temp_scale=2.0),  # hot + strict
+)
+
+
+def portfolio_configs(width: int) -> list[LaneConfig]:
+    """The first ``width`` portfolio lane configs (cycling past the
+    table, which no default reaches). Lane 0 is the default config."""
+    w = max(1, int(width))
+    return [PORTFOLIO_TABLE[i % len(PORTFOLIO_TABLE)] for i in range(w)]
 
 
 def band_pen(c, lo, hi):
@@ -76,6 +128,12 @@ class ModelArrays:
     rack_lo: jax.Array  # [K+1] int32 (null rack: 0)
     rack_hi: jax.Array  # [K+1] int32 (null rack: huge)
     part_rack_hi: jax.Array  # [P] int32
+    # lane config as DATA (docs/PORTFOLIO.md): scalar leaves, so every
+    # config shares one executable per bucket shape — jit keys on
+    # shapes, and () float32 is () float32 for every config
+    lam: jax.Array  # [] float32 penalty scale (default: LAMBDA)
+    temp_scale: jax.Array  # [] float32 temperature-ladder multiplier
+    comp_enable: jax.Array  # [] float32 1.0/0.0 compound-exchange gate
 
     @property
     def num_parts(self) -> int:
@@ -98,6 +156,7 @@ def from_instance(
     inst: ProblemInstance,
     num_parts: int | None = None,
     max_rf: int | None = None,
+    config: LaneConfig | None = None,
 ) -> ModelArrays:
     """Lower an instance to device arrays, optionally padded up to a
     canonical bucket shape (``solvers.tpu.bucket``) so every instance in
@@ -147,7 +206,37 @@ def from_instance(
         rack_lo=jnp.asarray(rack_lo),
         rack_hi=jnp.asarray(rack_hi),
         part_rack_hi=jnp.asarray(part_rack_hi, jnp.int32),
+        **_config_leaves(config or DEFAULT_CONFIG),
     )
+
+
+def _config_leaves(cfg: LaneConfig) -> dict:
+    """The config fields as device scalars — float32 end to end (the
+    accept arithmetic is float32; KAO103 discipline)."""
+    return {
+        "lam": jnp.asarray(np.float32(cfg.lam)),
+        "temp_scale": jnp.asarray(np.float32(cfg.temp_scale)),
+        "comp_enable": jnp.asarray(np.float32(1.0 if cfg.compound
+                                              else 0.0)),
+    }
+
+
+def with_config(m: ModelArrays, cfg: LaneConfig) -> ModelArrays:
+    """``m`` with its config leaves replaced — the cheap way to build a
+    portfolio stack: the heavy model tables are SHARED across lanes on
+    the host (``stack_models`` materializes the lane axis once, on
+    device)."""
+    return dataclasses.replace(m, **_config_leaves(cfg))
+
+
+def model_config(m: ModelArrays) -> dict:
+    """Host-readable view of a model's config leaves (provenance in
+    stats / flight records — docs/PORTFOLIO.md)."""
+    return {
+        "lam": float(np.asarray(m.lam)),
+        "temp_scale": float(np.asarray(m.temp_scale)),
+        "compound": bool(float(np.asarray(m.comp_enable)) > 0.5),
+    }
 
 
 def stack_models(models: list[ModelArrays]) -> ModelArrays:

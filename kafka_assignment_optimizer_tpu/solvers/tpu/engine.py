@@ -15,6 +15,7 @@ North-star target (BASELINE.json): plan quality <= lp_solve's move count,
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 import threading
 import time
@@ -97,6 +98,61 @@ _EXACT_RACE_VARS = 20_000  # 2 * brokers * partitions, the MILP var count
 _PIPELINE_DEFAULT = os.environ.get("KAO_NO_PIPELINE", "").lower() in (
     "", "off", "0", "none", "false",
 )
+
+# portfolio lanes (ISSUE 11, docs/PORTFOLIO.md): a defaulted sweep
+# solve races KAO_PORTFOLIO_WIDTH diverse lane configurations —
+# distinct penalty scales, temperature-ladder multipliers, and move
+# sets (arrays.PORTFOLIO_TABLE) — through the SAME lane-padded
+# executable the batched multi-tenant path compiles per bucket (config
+# is data: scalar ModelArrays leaves, so no per-config specialization).
+# First lane to certify at a chunk boundary retires the remaining
+# ladder; otherwise final selection reduces across every lane's
+# per-shard winners. Opt out per solve (portfolio=False /
+# --no-portfolio) or process-wide via KAO_NO_PORTFOLIO=1; falsy
+# spellings leave it ON — same convention as KAO_NO_PIPELINE.
+_PORTFOLIO_DEFAULT = os.environ.get("KAO_NO_PORTFOLIO", "").lower() in (
+    "", "off", "0", "none", "false",
+)
+
+
+def _env_portfolio_width() -> int:
+    """``KAO_PORTFOLIO_WIDTH`` with the same malformed-override
+    convention as KAO_BUCKETS/KAO_LANE_BUCKETS (solvers.tpu.bucket):
+    unparsable values fall back to the default instead of crashing the
+    first engine import. Width 1 is legal and means 'no racing'."""
+    raw = os.environ.get("KAO_PORTFOLIO_WIDTH", "").strip()
+    if not raw:
+        return 8
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 8
+
+
+_PORTFOLIO_WIDTH = _env_portfolio_width()
+
+
+def set_portfolio_default(enabled: bool) -> None:
+    """Process-wide default for solves that do not pass ``portfolio=``
+    explicitly (serve's ``--no-portfolio`` flag lands here)."""
+    global _PORTFOLIO_DEFAULT
+    _PORTFOLIO_DEFAULT = bool(enabled)
+
+
+def portfolio_width_default() -> int:
+    """The width a defaulted portfolio solve races (serve /healthz)."""
+    return _PORTFOLIO_WIDTH if _PORTFOLIO_DEFAULT else 1
+
+
+def _resolve_portfolio_width(portfolio) -> int:
+    """Resolve the ``portfolio`` knob to a lane count: None defers to
+    the process default, booleans toggle the default width, an int >= 2
+    names the width directly. 1 (or False) means off."""
+    if portfolio is None:
+        return _PORTFOLIO_WIDTH if _PORTFOLIO_DEFAULT else 1
+    if isinstance(portfolio, bool):
+        return _PORTFOLIO_WIDTH if portfolio else 1
+    return max(1, int(portfolio))
 
 
 def _leaves_alive(tree) -> bool:
@@ -311,6 +367,7 @@ def _solve_tpu(
     cert_min_savings_s: float = 1.0,
     precompile: bool = False,
     pipeline: bool | None = None,
+    portfolio: bool | int | None = None,
     warm_start: "np.ndarray | None" = None,
     budget: Budget | None = None,
     **_unused,
@@ -483,7 +540,7 @@ def _solve_tpu(
             t_lo, n_devices, engine, checkpoint, profile_dir,
             time_limit_s, backend_fut, t0, bounds_fut,
             cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
-            budget, warm_start,
+            budget, warm_start, portfolio,
         )
     except Exception as e:
         # the degradation ladder's last rung (docs/RESILIENCE.md): a
@@ -945,13 +1002,15 @@ class _LadderResult:
     dispatch_s: float = 0.0     # host time enqueueing chunks (incl. compile)
     device_s: float = 0.0       # host time blocked on device results
     boundary_overlap_s: float = 0.0  # boundary work hidden behind device chunks
+    winner_lane: int | None = None   # portfolio lane that certified first
+    certified_at_s: float | None = None  # solve-relative first-certificate time
 
 
 def _run_ladder(
     inst, m, mesh, chains_per_device, rounds, steps_per_round, engine,
     scorer, chunks, seed_dev, key, sweep_state, lp_fut, bounds_fut,
     multi, cert_min_savings_s, budget, profile_dir,
-    polish_starter=None, pipeline=True, warm_key=(),
+    polish_starter=None, pipeline=True, warm_key=(), lanes: int = 0,
 ) -> _LadderResult:
     """Stage 4 — the chunked annealing ladder: dispatch each schedule
     chunk to the mesh, then do the boundary work between chunks — adopt
@@ -976,9 +1035,19 @@ def _run_ladder(
     records the fallback (pipelined mode drains first: the failed
     speculation is retired synchronously after the current chunk's
     boundary, then the pipeline re-enters); anything else surfaces with
-    its real traceback."""
+    its real traceback.
+
+    ``lanes`` > 0 is the PORTFOLIO mode (docs/PORTFOLIO.md): ``m`` is a
+    lane-stacked model, ``sweep_state`` a lane state, and every chunk
+    dispatches through ``solve_lanes`` — the same lane-padded
+    executable the batched multi-tenant path uses. Boundary
+    certificates then race ACROSS lanes (the per-shard winner pool is
+    the flattened [n_dev x lanes] set; only the ``lanes`` real lanes
+    are read — padding lanes are inert by masking), and the first lane
+    to certify retires the remaining ladder, recording its index as
+    ``winner_lane``."""
     from ...parallel.mesh import (
-        fetch_global, fetch_global_async, solve_on_mesh,
+        fetch_global, fetch_global_async, solve_lanes, solve_on_mesh,
     )
 
     r = _LadderResult(scorer=scorer)
@@ -1030,11 +1099,18 @@ def _run_ladder(
         Chaos injection points fire HERE (_chaos_chunk_hooks)."""
         _chaos_chunk_hooks()
         td = time.perf_counter()
-        out = solve_on_mesh(
-            m, seed_dev, subs[i], mesh, chains_per_device, rounds,
-            steps_per_round, engine=engine, temps=chunks[i],
-            scorer=r.scorer, state=st,
-        )
+        if lanes:
+            out = solve_lanes(
+                m, mesh, chains_per_device, chunks[i], state=st,
+                engine=engine, steps_per_round=steps_per_round,
+                scorer=r.scorer,
+            )
+        else:
+            out = solve_on_mesh(
+                m, seed_dev, subs[i], mesh, chains_per_device, rounds,
+                steps_per_round, engine=engine, temps=chunks[i],
+                scorer=r.scorer, state=st,
+            )
         if engine == "sweep":
             new_state, pop_a, pop_k, curve = out
         else:
@@ -1090,7 +1166,10 @@ def _run_ladder(
         if sp is None:
             return
         t_np = np.asarray(chunks[i])
-        best = np.asarray(h.get()).max(axis=0)
+        # curve is [n_dev, rounds] — or [n_dev, L, rounds] under the
+        # portfolio — so reduce over every leading axis
+        arr = np.asarray(h.get())
+        best = arr.max(axis=tuple(range(arr.ndim - 1)))
         imp = int((np.diff(best) > 0).sum()) if best.size > 1 else 0
         sp.set(
             rounds=int(t_np.shape[0]),
@@ -1149,6 +1228,13 @@ def _run_ladder(
                 np.asarray(x)
                 for x in fetch_global((r.pop_a, r.pop_k))
             )
+            if lanes:
+                # portfolio: the candidate pool is every (device, lane)
+                # winner — REAL lanes only (padding lanes rerun lane 0
+                # and are never read). Flattened row-major, so a flat
+                # index j decodes to lane j % lanes.
+                pa = pa[:, :lanes].reshape(-1, *pa.shape[2:])
+                pk = pk[:, :lanes].reshape(-1)
             # test ONLY the top-ranked shard winner: the key ranks by
             # weight, so a lower-ranked candidate cannot pass a weight
             # bound the top one failed, and repeating the reseat LP per
@@ -1189,6 +1275,15 @@ def _run_ladder(
                         r.certified_a = cand
                         break
             if r.certified_a is not None:
+                # first-to-certify provenance (docs/PORTFOLIO.md): the
+                # flat index `j` that certified decodes to its lane,
+                # and the certificate time is solve-relative (the
+                # bench's time-to-first-certificate column)
+                if lanes:
+                    r.winner_lane = int(j % lanes)
+                r.certified_at_s = round(
+                    time.perf_counter() - budget.t0, 4
+                )
                 return True
             if do_cert and polish_starter is not None:
                 # a certificate check ran and did NOT certify: first
@@ -1533,7 +1628,7 @@ def _build_chunks(inst, engine, rounds, t_hi, t_lo, time_limit_s):
 
 def _final_selection(
     inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
-    budget, multi,
+    budget, multi, lanes: int = 0,
 ):
     """Stage 5 — final selection: exact-rescore the per-shard winners on
     device (the Pallas kernel on TPU, XLA elsewhere) and rank by
@@ -1547,9 +1642,12 @@ def _final_selection(
     Joins block (no .done() polls), so multi-controller workers reach
     identical verdicts.
 
-    Returns ``(best_a, final_cert, lp_plan_won)`` where ``final_cert``
-    names the certify-first outcome ("ok"/"ok_reseat" mean the polish
-    was provably unnecessary and was skipped)."""
+    Returns ``(best_a, final_cert, lp_plan_won, winner_lane)`` where
+    ``final_cert`` names the certify-first outcome ("ok"/"ok_reseat"
+    mean the polish was provably unnecessary and was skipped) and
+    ``winner_lane`` is the portfolio lane the champion came from (None
+    when ``lanes`` is 0 — the DrJAX-style best-feasible reduction over
+    the lane axis happens right here, docs/PORTFOLIO.md)."""
     from ...ops.score import moves_batch
     from ...ops.score_pallas import score_batch_auto
     from ...parallel.mesh import fetch_global
@@ -1558,6 +1656,12 @@ def _final_selection(
     # is n_dev candidates, a few hundred KB) — Mosaic kernels cannot be
     # auto-partitioned
     pop_a = jnp.asarray(fetch_global(pop_a))
+    if lanes:
+        # portfolio: [n_dev, Lp, P, R] -> the real lanes' winners as
+        # one flat pool; flat index j decodes to lane j % lanes. The
+        # base (default-config) model scores every lane — scoring is
+        # weight/penalty algebra, config-independent by construction.
+        pop_a = pop_a[:, :lanes].reshape(-1, *pop_a.shape[2:])
     s = score_batch_auto(pop_a, m)
     moves = moves_batch(pop_a, m)
     # lexicographic in two int32-safe stages (a combined key would
@@ -1565,9 +1669,11 @@ def _final_selection(
     # fewest moves as the tie-break
     primary = jnp.where(s.penalty == 0, s.weight, -s.penalty - 1)
     tied = primary == primary.max()
-    cand = pop_a[jnp.argmax(
+    top = jnp.argmax(
         jnp.where(tied, -moves, jnp.iinfo(jnp.int32).min)
-    )]
+    )
+    cand = pop_a[top]
+    winner_lane = int(top) % lanes if lanes else None
     certified_final = None
     final_cert = "budget_spent"  # why the attempt concluded
     left = budget.remaining()
@@ -1613,7 +1719,7 @@ def _final_selection(
     if certified_final is not None:
         # the caller's final proof block re-derives the certificate
         # from the (memoized) bounds — no special-casing needed
-        return certified_final, final_cert, False
+        return certified_final, final_cert, False, winner_lane
     pol = polish_jit
     if polish_fut is not None:
         # join the ladder-overlapped compile (free when the ladder
@@ -1672,7 +1778,9 @@ def _final_selection(
             if rank(plan) > rank(best_a):
                 best_a = plan
                 lp_won = True
-    return best_a, final_cert, lp_won
+    return best_a, final_cert, lp_won, (
+        None if lp_won else winner_lane
+    )
 
 
 def _solve_tpu_inner(
@@ -1681,6 +1789,7 @@ def _solve_tpu_inner(
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
     lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
     pipeline=True, budget: Budget | None = None, warm_start=None,
+    portfolio=None,
 ) -> SolveResult:
     timed_out = False
     early_stopped = False
@@ -1830,14 +1939,62 @@ def _solve_tpu_inner(
         jnp.asarray(arrays.pad_candidate(a_seed, m), jnp.int32)
         if certified_a is None else None
     )
-    # sweep engine: full population state (including the per-shard RNG
-    # keys) threads through the chunks — the chunked schedule replays
-    # exactly the uncut ladder's trajectory
-    sweep_state = (
-        init_sweep_state(m, seed_dev, key, mesh, chains_per_device)
-        if engine == "sweep" and certified_a is None
-        else None
+    # portfolio lanes (docs/PORTFOLIO.md): race pw diverse configs in
+    # one lane-padded dispatch. Sweep engine only (the chain engine's
+    # small-instance niche keeps its sequential shape), single
+    # controller only (the early-exit boundary races are host-side and
+    # must not desync SPMD workers).
+    pw = (
+        _resolve_portfolio_width(portfolio)
+        if (certified_a is None and engine == "sweep" and not multi)
+        else 1
     )
+    port_lanes = 0  # padded dispatch width (0 = portfolio off)
+    port_cfgs: list = []
+    if pw > 1:
+        from ...parallel.mesh import init_lane_state
+        from . import bucket
+
+        port_cfgs = arrays.portfolio_configs(pw)
+        # pad the lane count up the SAME rung ladder the batched
+        # multi-tenant path uses, so the portfolio dispatch reuses the
+        # one lane-padded executable per bucket (padding lanes rerun
+        # lane 0's default config and are masked at selection)
+        port_lanes = bucket.lane_bucket(pw)
+        port_models = [arrays.with_config(m, c) for c in port_cfgs]
+        port_models += [port_models[0]] * (port_lanes - pw)
+        m_solver = arrays.stack_models(port_models)
+        lane_seeds = np.broadcast_to(
+            np.asarray(seed_dev, np.int32),
+            (port_lanes, *seed_dev.shape),
+        )
+        # lane 0 consumes the solo path's key VERBATIM (the width-1
+        # parity anchor: a 1-lane portfolio is bit-identical to the
+        # solo solve); diversity lanes and padding lanes fold distinct
+        # stream ids so no two lanes share a stream
+        lane_keys = jnp.stack(
+            [key]
+            + [jax.random.fold_in(key, i) for i in range(1, pw)]
+            + [jax.random.fold_in(key, pw + j)
+               for j in range(port_lanes - pw)]
+        )
+        from ...parallel.mesh import note_lane_serve
+
+        note_lane_serve((inst.num_brokers, inst.num_racks,
+                         int(bkt_parts), int(bkt_rf)), pw, port_lanes)
+        sweep_state = init_lane_state(
+            m_solver, lane_seeds, lane_keys, mesh, chains_per_device
+        )
+    else:
+        m_solver = m
+        # sweep engine: full population state (including the per-shard
+        # RNG keys) threads through the chunks — the chunked schedule
+        # replays exactly the uncut ladder's trajectory
+        sweep_state = (
+            init_sweep_state(m, seed_dev, key, mesh, chains_per_device)
+            if engine == "sweep" and certified_a is None
+            else None
+        )
     if not chunks:
         polish_jit = None  # device path never imported (certified)
     # the polish AOT compile is LAZY (r5): the certify-first design
@@ -1872,17 +2029,31 @@ def _solve_tpu_inner(
         # warm-chunk estimates are propagated across solves per
         # executable identity; the "single" tag keeps this sequential
         # path's estimates disjoint from the batched lane path's (a
-        # batched chunk does L lanes of device work per dispatch)
-        warm_key = ("single", engine, n_dev, chains_per_device,
-                    steps_per_round, int(bkt_parts), int(bkt_rf))
+        # batched chunk does L lanes of device work per dispatch). The
+        # portfolio path tags itself with the SAME ("lanes", Lp, ...)
+        # key space as the multi-tenant batch path — they dispatch the
+        # identical lane-padded executable, so they share its estimate.
+        if port_lanes:
+            warm_key = ("lanes", port_lanes, engine, n_dev,
+                        chains_per_device, steps_per_round,
+                        int(bkt_parts), int(bkt_rf))
+        else:
+            warm_key = ("single", engine, n_dev, chains_per_device,
+                        steps_per_round, int(bkt_parts), int(bkt_rf))
+        # the `portfolio` span (docs/PORTFOLIO.md): zero-duration,
+        # attribute-only marker so solve reports carry the racing
+        # geometry even when the ladder span is the one timed
+        if port_lanes:
+            _otrace.mark("portfolio", width=pw, lane_bucket=port_lanes)
         with _otrace.span("ladder", engine=engine,
                           chunks=len(chunks)) as _sp:
             lad = _run_ladder(
-                inst, m, mesh, chains_per_device, rounds, steps_per_round,
-                engine, scorer, chunks, seed_dev, key, sweep_state, lp_fut,
-                bounds_fut, multi, cert_min_savings_s, budget,
-                profile_dir, polish_starter=_start_polish_aot,
-                pipeline=pipeline, warm_key=warm_key,
+                inst, m_solver, mesh, chains_per_device, rounds,
+                steps_per_round, engine, scorer, chunks, seed_dev, key,
+                sweep_state, lp_fut, bounds_fut, multi,
+                cert_min_savings_s, budget, profile_dir,
+                polish_starter=_start_polish_aot, pipeline=pipeline,
+                warm_key=warm_key, lanes=pw if port_lanes else 0,
             )
             if _sp is not None:
                 _sp.set(rounds_run=lad.rounds_run,
@@ -1892,7 +2063,8 @@ def _solve_tpu_inner(
                         device_s=round(lad.device_s, 4),
                         boundary_overlap_s=round(
                             lad.boundary_overlap_s, 4),
-                        boundary_certified=lad.certified_a is not None)
+                        boundary_certified=lad.certified_a is not None,
+                        portfolio_width=pw if port_lanes else None)
     else:
         # constructed fast path: the ladder never runs, and calling into
         # it would import device-adjacent modules this path avoids
@@ -1915,12 +2087,14 @@ def _solve_tpu_inner(
         constructed = constructed or lad.constructed
     t_solve = time.perf_counter()
     curve = (
-        np.concatenate(lad.curves, axis=1) if lad.curves
+        np.concatenate(lad.curves, axis=-1) if lad.curves
         else np.zeros((1, 0), dtype=np.int64)
     )
-    # best-score trajectory (max over shards): stats' score_curve and
-    # the solve report's annealing summary share one computation
-    best_curve = np.asarray(jax.device_get(curve)).max(axis=0)
+    # best-score trajectory (max over shards — and over lanes on the
+    # portfolio path): stats' score_curve and the solve report's
+    # annealing summary share one computation
+    curve = np.asarray(jax.device_get(curve))
+    best_curve = curve.max(axis=tuple(range(curve.ndim - 1)))
     if _otrace.active():
         _imp = (
             int((np.diff(best_curve) > 0).sum())
@@ -1934,6 +2108,7 @@ def _solve_tpu_inner(
             plateau_rounds=max(0, int(best_curve.size) - 1 - _imp),
         )
 
+    winner_lane = lad.winner_lane
     if certified_a is not None:
         # a chunk-boundary candidate already carries the optimality
         # certificate — selection and polish cannot improve a proven
@@ -1946,9 +2121,9 @@ def _solve_tpu_inner(
         # certificate failure) the steepest-descent polish itself —
         # final_cert names which of those actually ran
         with _otrace.span("polish") as _sp:
-            best_a, final_cert, lp_won = _final_selection(
+            best_a, final_cert, lp_won, winner_lane = _final_selection(
                 inst, m, pop_a, polish_jit, polish_fut, bounds_fut, lp_fut,
-                budget, multi,
+                budget, multi, lanes=pw if port_lanes else 0,
             )
             if _sp is not None:
                 _sp.set(final_cert=final_cert, lp_plan_won=lp_won)
@@ -2081,6 +2256,28 @@ def _solve_tpu_inner(
             "boundary_overlap_s": round(lad.boundary_overlap_s, 4),
             **({"pallas_fallback": pallas_fallback} if pallas_fallback
                else {}),
+            # portfolio provenance (docs/PORTFOLIO.md): the racing
+            # geometry, the winning lane and its config, and — when a
+            # boundary certificate retired the ladder — the
+            # solve-relative time-to-first-certificate
+            **({"portfolio": {
+                "width": pw,
+                "lane_bucket": port_lanes,
+                "winner_lane": winner_lane,
+                "winner_config": (
+                    dataclasses.asdict(port_cfgs[winner_lane])
+                    if winner_lane is not None else None
+                ),
+                # a LANE certificate retired the ladder — a boundary
+                # adoption of the constructor's plan is an early stop
+                # too, but not a portfolio win, and must not skew the
+                # first-to-certify metrics
+                "early_exit": (
+                    lad.certified_a is not None and not lad.constructed
+                ),
+                **({"certified_at_s": lad.certified_at_s}
+                   if lad.certified_at_s is not None else {}),
+            }} if port_lanes else {}),
             # certify-first outcome at final selection (None when a
             # boundary/constructor certificate made it moot): "ok" /
             # "ok_reseat" mean the polish was provably unnecessary and
@@ -2186,6 +2383,7 @@ def _solve_tpu_batch_impl(
     certify: bool = False,
     trace: bool | str | None = None,
     pipeline: bool | None = None,
+    portfolio: bool | int | None = None,
     precompile: bool = False,  # consumed by the solve_tpu_batch wrapper
 ) -> list[SolveResult]:
     """Solve L independent instances in ONE batched device dispatch —
@@ -2229,7 +2427,14 @@ def _solve_tpu_batch_impl(
     ``pipeline`` controls the double-buffered ladder dispatch exactly
     as in :func:`solve_tpu` (docs/PIPELINE.md): the sweep engine's
     chunk i+1 is dispatched before chunk i is retired. None defers to
-    the process default."""
+    the process default.
+
+    ``portfolio`` is accepted for option symmetry with
+    :func:`solve_tpu` (serve's batchable ``options.portfolio``) but
+    the BATCHED dispatch deliberately ignores it: multi-tenant lanes
+    already occupy the lane-padded width the portfolio would race —
+    the idle roofline is spent either way (docs/PORTFOLIO.md). The
+    unstackable sequential fallback honors it per lane."""
     t0 = time.perf_counter()
     pipeline = _PIPELINE_DEFAULT if pipeline is None else bool(pipeline)
     if _san.enabled():
@@ -2265,7 +2470,8 @@ def _solve_tpu_batch_impl(
                                       sweeps=sweeps, t_hi=t_hi,
                                       t_lo=t_lo, n_devices=n_devices,
                                       time_limit_s=time_limit_s,
-                                      pipeline=pipeline)
+                                      pipeline=pipeline,
+                                      portfolio=portfolio)
                 if lane_rungs:
                     r.stats["degradations"] = list(lane_rungs)
                 r.stats["lane_fallback"] = (

@@ -47,7 +47,6 @@ from jax import lax, random
 
 from ...ops.score import moves_batch
 from .arrays import (
-    LAMBDA,
     SCALE_W,
     ModelArrays,
     band_pen as _band_pen,
@@ -56,6 +55,13 @@ from .arrays import (
 
 P_LSWAP = 0.15  # leadership-only proposals (zero replica movement)
 P_RESTORE = 0.5  # replace proposals that re-propose the original broker
+
+# compound 2-move exchange cadence (PR 11, docs/PORTFOLIO.md): every
+# COMPOUND_EVERY-th sweep the odd (exchange) slot runs the ATOMIC
+# two-replace move instead of the count-invariant pair exchange. The
+# cadence divides the snapshot cadence (8) and the chunk parity (even),
+# so chunked schedules keep replaying the uncut ladder bit-for-bit.
+COMPOUND_EVERY = 4
 
 
 def _histograms(m: ModelArrays, a: jax.Array):
@@ -137,6 +143,10 @@ class ScorerBundle(NamedTuple):
       + exact histogram resync in one pass
     - ``site_step(m, a, cnt, lcnt, rcnt, key, temp)`` -> updated 4-tuple
     - ``exch_step(m, a, cnt, lcnt, rcnt, key, temp)`` -> updated 4-tuple
+    - ``comp_step(...)`` — the compound 2-move exchange sweep; one
+      shared XLA implementation for every scorer (it runs 1 sweep in
+      ``COMPOUND_EVERY``, off the Mosaic hot path, so the Pallas bundle
+      executes the identical code CI pins)
     """
 
     hists: object
@@ -146,6 +156,7 @@ class ScorerBundle(NamedTuple):
     full: object
     site_step: object
     exch_step: object
+    comp_step: object
 
 
 def _make_scorer(scorer: str) -> ScorerBundle:
@@ -165,6 +176,7 @@ def _make_scorer(scorer: str) -> ScorerBundle:
         return ScorerBundle(
             _histograms, chain_scores, None, None, _full_scores_xla,
             _site_sweep_delta, _exchange_sweep_delta,
+            _compound_sweep_delta,
         )
 
     import functools
@@ -201,6 +213,7 @@ def _make_scorer(scorer: str) -> ScorerBundle:
         hists, scores, propose, halves, full,
         functools.partial(site_step_pallas, interpret=interpret),
         functools.partial(exchange_step_pallas, interpret=interpret),
+        _compound_sweep_delta,
     )
 
 
@@ -371,7 +384,12 @@ def propose_site(m: ModelArrays, a: jax.Array, bits: jax.Array, temp,
     legal = jnp.logical_and(
         jnp.where(is_lsw, rf > 1, legal_rep), rf > 0
     )
-    delta = (SCALE_W * dw - LAMBDA * dpen).astype(jnp.float32)
+    # penalty scale as DATA (m.lam, docs/PORTFOLIO.md): the int deltas
+    # are exact in float32 (< 2^24), so for the default config this is
+    # bit-identical to the historical int `SCALE_W*dw - LAMBDA*dpen`
+    delta = (SCALE_W * dw).astype(jnp.float32) - m.lam * dpen.astype(
+        jnp.float32
+    )
 
     # ---- Metropolis accept -------------------------------------------
     accept = jnp.logical_and(
@@ -696,7 +714,9 @@ def propose_exchange(m: ModelArrays, a, key, temp,
         jnp.logical_and(legal_own, other[..., 2] > 0),
         jnp.logical_and(pair_valid, pair_live),
     )
-    delta = (SCALE_W * dw - LAMBDA * (dlcnt + ddiv)).astype(jnp.float32)
+    delta = (SCALE_W * dw).astype(jnp.float32) - m.lam * (
+        dlcnt + ddiv
+    ).astype(jnp.float32)
     accept = jnp.logical_and(
         legal,
         jnp.logical_or(
@@ -780,6 +800,270 @@ def _exchange_sweep_delta(m: ModelArrays, a: jax.Array, cnt, lcnt, rcnt,
     a2 = exchange_thin_apply(m, a, prop)
     lcnt = lcnt + _hist_delta(a[:, :, 0], a2[:, :, 0], m.num_brokers + 1)
     return a2, cnt, lcnt, rcnt
+
+
+class CompoundProposals(NamedTuple):
+    """One half of a compound 2-move exchange per (chain, partition),
+    partition-aligned like :class:`ExchangeProposals`: partition p
+    replaces its slot-``s`` occupant ``b_out`` with a freshly drawn
+    ``b_in`` (restore-biased, like a site replace) — and its PAIRED
+    partition does the same, atomically. Both halves carry the pair's
+    shared ``prio``, so thinning and apply reach one decision."""
+
+    s: jax.Array       # [N, P] int32 own slot
+    b_out: jax.Array   # [N, P] int32 outgoing broker (slot occupant)
+    b_in: jax.Array    # [N, P] int32 incoming broker (fresh draw)
+    lead_mv: jax.Array  # [N, P] bool — own slot is the leader slot
+    prio: jax.Array    # [N, P] float32, 0 where rejected
+
+
+def _pair_pen_delta(hist, outs, ins, lo_of, hi_of):
+    """Exact band-penalty delta of a pair's unit moves applied
+    ATOMICALLY: ``hist [N, W]``; ``outs``/``ins`` are lists of [N, P]
+    token arrays in canonical (lower-side-first) order, identical on
+    both sides of a pair. Per token: the NET count change of its bin
+    across all four moves, priced once per distinct bin via
+    first-occurrence masking — so two moves loading one broker cost
+    ``band_pen(c+2) - band_pen(c)``, not twice the single-step delta.
+    Sentinel tokens (the null broker/rack) always arrive in matched
+    out/in pairs, net to zero, and contribute nothing."""
+    toks = list(outs) + list(ins)
+    signs = [-1] * len(outs) + [1] * len(ins)
+    n_idx = jnp.arange(hist.shape[0])[:, None]
+    total = jnp.zeros_like(toks[0])
+    for j, tj in enumerate(toks):
+        net = jnp.zeros_like(tj)
+        for sk, tk in zip(signs, toks):
+            net = net + sk * (tk == tj).astype(jnp.int32)
+        first = jnp.ones(tj.shape, bool)
+        for tk in toks[:j]:
+            first = jnp.logical_and(first, tk != tj)
+        c = hist[n_idx, tj]
+        lo, hi = lo_of(tj), hi_of(tj)
+        d = _band_pen(c + net, lo, hi) - _band_pen(c, lo, hi)
+        total = total + jnp.where(first, d, 0)
+    return total
+
+
+def propose_compound(m: ModelArrays, a, key, temp, cnt, lcnt, rcnt):
+    """Evaluate one compound 2-move exchange per (chain, partition):
+    the pair (``_pair_partners`` stride pairing, shared with the plain
+    exchange) proposes TWO single-site replaces — each side replaces
+    its slot occupant with a fresh restore-biased draw — scored as ONE
+    atomic move against the carried histograms, with the cross terms
+    between the two halves priced exactly (``_pair_pen_delta``).
+
+    This is the move the exact-band instances need (docs/ANALYSIS.md
+    messy[1] triage): each half alone passes through a penalized state
+    (accept probability ~e^-lam/t), but the compound delta sees only
+    the endpoints, so a relocation or 3-broker rotation that restores
+    every band atomically is accepted on its merits. Subsumes neither
+    the pair exchange (which stays cheaper per sweep) nor the site
+    move — it runs on its own cadence (``COMPOUND_EVERY``).
+
+    A lane whose config disables the move (``m.comp_enable`` = 0,
+    docs/PORTFOLIO.md) rejects every proposal — the sweep itself stays
+    lane-invariant, so one executable serves every config.
+
+    Returns ``(proposals, d, is_lower)`` — the pairing geometry rides
+    along so thinning can align partner decisions."""
+    N, P, R = a.shape
+    B = m.num_brokers
+    kd, kbits = random.split(key)
+    bits = random.bits(kbits, (N, P, 7), jnp.uint32)
+    d, is_lower, pair_valid = _pair_partners(kd, N, P)
+
+    # pair-shared draws are the LOWER side's bits (slot lanes 0-1,
+    # accept lane 5, prio lane 6); the incoming-broker draw (lanes
+    # 2-4) is PER SIDE — each half picks its own replacement
+    bits_low = jnp.where(is_lower[..., None], bits,
+                         _partner_view(bits, d, is_lower))
+    u0 = _u01(bits_low[..., 0])
+    u1 = _u01(bits_low[..., 1])
+    rf_own = jnp.broadcast_to(m.rf[None, :], (N, P))
+    rf_other = jnp.broadcast_to(
+        jnp.where(is_lower, jnp.roll(m.rf, -d)[None, :],
+                  jnp.roll(m.rf, d)[None, :]),
+        (N, P),
+    )
+    s_own = _rand_idx(jnp.where(is_lower, u0, u1), rf_own)
+
+    p_idx = jnp.arange(P)[None, :]
+    r_iota = jnp.arange(R)[None, None, :]
+    b_out = (jnp.where(r_iota == s_own[:, :, None], a, 0)).sum(-1)
+
+    # incoming broker: restore-biased fresh draw (the site move's
+    # proposal shape — the restore path is what walks compound
+    # relocations back toward the move-count optimum)
+    b_uni = _rand_idx(_u01(bits[..., 2]), jnp.int32(B))
+    s_orig = _rand_idx(_u01(bits[..., 3]), jnp.int32(R))
+    b_orig = m.a0[jnp.broadcast_to(p_idx, s_orig.shape), s_orig]
+    b_in = jnp.where(
+        jnp.logical_and(_u01(bits[..., 4]) < P_RESTORE, b_orig < B),
+        b_orig,
+        b_uni,
+    )
+
+    # own-row terms: role-aware weight, diversity, row legality
+    lead_own = s_own == 0
+    dw_own = jnp.where(
+        lead_own,
+        m.w_lead[p_idx, b_in] - m.w_lead[p_idx, b_out],
+        m.w_foll[p_idx, b_in] - m.w_foll[p_idx, b_out],
+    )
+    flat = jnp.where(m.slot_valid[None], a, B)
+    racks = m.rack_of[flat]
+    r_out = m.rack_of[b_out]
+    r_in = m.rack_of[b_in]
+    c_out = (racks == r_out[:, :, None]).sum(-1)
+    c_in = (racks == r_in[:, :, None]).sum(-1)
+    cap = m.part_rack_hi[None, :]
+
+    def g(c):
+        return jnp.maximum(c - cap, 0)
+
+    ddiv_own = jnp.where(
+        r_out != r_in,
+        g(c_out - 1) - g(c_out) + g(c_in + 1) - g(c_in),
+        0,
+    )
+    in_row = jnp.logical_and(
+        flat == b_in[:, :, None], m.slot_valid[None]
+    ).any(-1)
+    legal_own = ~in_row  # also rejects the no-op b_in == b_out
+
+    # partner's half via ONE partner-aligned roll of the packed record
+    packed = jnp.stack(
+        [b_out, b_in, lead_own.astype(jnp.int32), dw_own, ddiv_own,
+         legal_own.astype(jnp.int32)],
+        axis=-1,
+    )
+    oth = _partner_view(packed, d, is_lower)
+    b_out_o, b_in_o = oth[..., 0], oth[..., 1]
+    lead_o = oth[..., 2] > 0
+
+    # canonical (lower-first) token order so both sides price the
+    # identical 4-token histogram deltas
+    def canon(own, other):
+        return (jnp.where(is_lower, own, other),
+                jnp.where(is_lower, other, own))
+
+    o_lo, o_up = canon(b_out, b_out_o)
+    i_lo, i_up = canon(b_in, b_in_o)
+    blo, bhi = m.broker_band[0], m.broker_band[1]
+    d_cnt = _pair_pen_delta(
+        cnt, [o_lo, o_up], [i_lo, i_up],
+        lambda t: blo, lambda t: bhi,
+    )
+    d_rcnt = _pair_pen_delta(
+        rcnt,
+        [m.rack_of[o_lo], m.rack_of[o_up]],
+        [m.rack_of[i_lo], m.rack_of[i_up]],
+        lambda t: m.rack_lo[t], lambda t: m.rack_hi[t],
+    )
+    led_lo, led_up = canon(lead_own, lead_o)
+    llo, lhi = m.leader_band[0], m.leader_band[1]
+    d_lcnt = _pair_pen_delta(
+        lcnt,
+        [jnp.where(led_lo, o_lo, B), jnp.where(led_up, o_up, B)],
+        [jnp.where(led_lo, i_lo, B), jnp.where(led_up, i_up, B)],
+        lambda t: llo, lambda t: lhi,
+    )
+
+    dw = dw_own + oth[..., 3]
+    dpen = d_cnt + d_rcnt + d_lcnt + ddiv_own + oth[..., 4]
+    pair_live = jnp.logical_and(rf_own > 0, rf_other > 0)
+    legal = jnp.logical_and(
+        jnp.logical_and(legal_own, oth[..., 5] > 0),
+        jnp.logical_and(pair_valid, pair_live),
+    )
+    # per-lane config gate (docs/PORTFOLIO.md): a disabled lane rejects
+    # every compound proposal; the sweep structure stays lane-invariant
+    legal = jnp.logical_and(legal, m.comp_enable > 0.5)
+    delta = (SCALE_W * dw).astype(jnp.float32) - m.lam * dpen.astype(
+        jnp.float32
+    )
+    accept = jnp.logical_and(
+        legal,
+        jnp.logical_or(
+            delta >= 0,
+            _u01(bits_low[..., 5]) < jnp.exp(
+                delta / jnp.maximum(temp, 1e-6)
+            ),
+        ),
+    )
+    prio = jnp.where(accept, _u01(bits_low[..., 6]) + jnp.float32(1e-6),
+                     0.0)
+    return (
+        CompoundProposals(s=s_own, b_out=b_out, b_in=b_in,
+                          lead_mv=lead_own, prio=prio),
+        d, is_lower,
+    )
+
+
+def _compound_sweep_delta(m: ModelArrays, a: jax.Array, cnt, lcnt, rcnt,
+                          key: jax.Array, temp):
+    """One compound 2-move exchange sweep against the carried
+    histograms: propose (pair-atomic), conflict-thin, apply, and
+    delta-update the carry exactly — each kept half is one replace, so
+    the update is :func:`_hist_delta` over the kept tokens, and the
+    carried histograms stay bit-identical to a from-scratch rebuild.
+
+    Thinning extends the site rule pair-atomically: a half must own
+    the priority maps of both brokers it moves AND its partner half
+    must win its own maps — a pair is kept or dropped whole (both
+    halves share one prio, so the partner check is one roll). Shared
+    by every scorer bundle: compound sweeps are 1-in-COMPOUND_EVERY,
+    off the Mosaic hot path by design."""
+    N, P = a.shape[:2]
+    if P < 2:
+        return a, cnt, lcnt, rcnt
+    B = m.num_brokers
+    prop, d, is_lower = propose_compound(m, a, key, temp, cnt, lcnt,
+                                         rcnt)
+    n_idx = jnp.arange(N)[:, None]
+    m_out = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, prop.b_out].max(
+        prop.prio
+    )
+    m_in = jnp.zeros((N, B + 1), jnp.float32).at[n_idx, prop.b_in].max(
+        prop.prio
+    )
+    win_own = jnp.logical_and(
+        prop.prio > 0,
+        jnp.logical_and(
+            prop.prio == m_out[n_idx, prop.b_out],
+            prop.prio == m_in[n_idx, prop.b_in],
+        ),
+    )
+    keep = jnp.logical_and(win_own,
+                           _partner_view(win_own, d, is_lower))
+
+    r_iota = jnp.arange(a.shape[2])[None, None, :]
+    write = jnp.logical_and(keep[:, :, None],
+                            r_iota == prop.s[:, :, None])
+    a2 = jnp.where(write, prop.b_in[:, :, None], a)
+
+    out_b = jnp.where(keep, prop.b_out, B)
+    in_b = jnp.where(keep, prop.b_in, B)
+    cnt = cnt + _hist_delta(out_b, in_b, B + 1)
+    rcnt = rcnt + _hist_delta(
+        m.rack_of[out_b], m.rack_of[in_b], m.rack_lo.shape[0]
+    )
+    lead = jnp.logical_and(keep, prop.lead_mv)
+    l_out = jnp.where(lead, prop.b_out, B)
+    l_in = jnp.where(lead, prop.b_in, B)
+    lcnt = lcnt + _hist_delta(l_out, l_in, B + 1)
+    return a2, cnt, lcnt, rcnt
+
+
+def compound_sweep(m: ModelArrays, a: jax.Array, key: jax.Array, temp):
+    """From-scratch form of the compound sweep (tests and reference
+    loops): rebuild the exact histograms, run one compound 2-move
+    exchange sweep, return the applied population."""
+    _flat, _racks, cnt, lcnt, rcnt = _histograms(m, a)
+    a2, _c, _l, _r = _compound_sweep_delta(m, a, cnt, lcnt, rcnt, key,
+                                           temp)
+    return a2
 
 
 def make_sweep_solver_fn(
@@ -874,6 +1158,7 @@ def make_sweep_stepper_fn(
     sc = _make_scorer(scorer)
     hists, full = sc.hists, sc.full
     site_step, exch_step = sc.site_step, sc.exch_step
+    comp_step = sc.comp_step
 
     def solve(m: ModelArrays, state, temps: jax.Array):
         sweeps = temps.shape[0]
@@ -894,12 +1179,21 @@ def make_sweep_stepper_fn(
 
         def body(carry, xs):
             a, cnt, lcnt, rcnt, best_k, best_mv, best_a, key = carry
-            temp, do_snap, do_exchange = xs
+            temp, do_snap, do_exchange, do_compound = xs
+            # per-lane ladder scaling as DATA (docs/PORTFOLIO.md): the
+            # shared schedule times m.temp_scale — exact for the
+            # default config (x * 1.0 is bit-identical in float32)
+            temp = temp * m.temp_scale
             key, sub = random.split(key)
             a, cnt, lcnt, rcnt = lax.cond(
-                do_exchange,
-                lambda ops: exch_step(m, *ops, sub, temp),
-                lambda ops: site_step(m, *ops, sub, temp),
+                do_compound,
+                lambda ops: comp_step(m, *ops, sub, temp),
+                lambda ops: lax.cond(
+                    do_exchange,
+                    lambda o: exch_step(m, *o, sub, temp),
+                    lambda o: site_step(m, *o, sub, temp),
+                    ops,
+                ),
                 (a, cnt, lcnt, rcnt),
             )
 
@@ -994,11 +1288,16 @@ def make_sweep_stepper_fn(
             idx % snapshot_every == snapshot_every - 1, idx == sweeps - 1
         )
         # odd sweeps run the count-invariant pair-exchange move; even
-        # sweeps run single-site replace/lswap proposals
+        # sweeps run single-site replace/lswap proposals; every
+        # COMPOUND_EVERY-th sweep the exchange slot runs the atomic
+        # compound 2-move exchange instead (exact-band tunneling)
         do_exchange = jnp.arange(sweeps) % 2 == 1
+        do_compound = jnp.arange(sweeps) % COMPOUND_EVERY == (
+            COMPOUND_EVERY - 1
+        )
         (a, cnt, lcnt, rcnt, best_k, best_mv, best_a, key), curve = lax.scan(
             body, (a, cnt, lcnt, rcnt, best_k, best_mv, best_a, key),
-            (temps, do_snap, do_exchange)
+            (temps, do_snap, do_exchange, do_compound)
         )
         tied = best_k == jnp.max(best_k)
         top = jnp.argmin(

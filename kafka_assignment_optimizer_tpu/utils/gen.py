@@ -517,6 +517,51 @@ def adv50k(
     )
 
 
+def messy_cluster(rng):
+    """One deliberately irregular worst-case cluster (the property
+    fuzz's messy family, docs/ANALYSIS.md): several topics with
+    different partition counts and RFs, a lopsided rack map (rack 0
+    holds ~half the brokers; exact bands with single-broker racks are
+    common), and a broker list that may both drop and add brokers.
+    THE one generator — tests/test_property_fuzz.py and the bench
+    portfolio A/B both consume it, so the 'messy[1] was the tier-1
+    xfail' correspondence can never silently desynchronize. Returns
+    ``(current, broker_list, topology, target_rf)``."""
+    n_brokers = int(rng.integers(6, 16))
+    n_topics = int(rng.integers(1, 4))
+    parts = []
+    for t in range(n_topics):
+        rf = int(rng.integers(1, min(4, n_brokers) + 1))
+        for p in range(int(rng.integers(2, 9))):
+            reps = rng.choice(n_brokers, size=rf, replace=False)
+            parts.append(PartitionAssignment(
+                f"topic-{t}", p, [int(b) for b in reps]
+            ))
+    n_racks = int(rng.integers(1, 4))
+    add = int(rng.integers(0, 3))
+    all_ids = list(range(n_brokers + add))
+    rack_of = {
+        b: f"rack{0 if b % 4 < 2 else (b % n_racks)}" for b in all_ids
+    }
+    drop = int(rng.integers(0, 2))
+    brokers = all_ids[drop:]
+    target_rf = None
+    if rng.random() < 0.3:
+        target_rf = int(rng.integers(1, 4))
+    return (Assignment(partitions=parts), brokers,
+            Topology(rack_of=rack_of), target_rf)
+
+
+def messy_case(seed: int = 0):
+    """The sweep-test family's seeding of :func:`messy_cluster`
+    (``default_rng(2000 + seed)``): ``messy_case(1)`` IS the instance
+    ``test_sweep_engine_on_messy_clusters[1]`` pins — the exact-band
+    tier-1 xfail the portfolio lanes closed (docs/PORTFOLIO.md)."""
+    import numpy as _np
+
+    return messy_cluster(_np.random.default_rng(2000 + int(seed)))
+
+
 SCENARIOS = {
     "demo": demo,
     "scale_out": scale_out,

@@ -300,6 +300,69 @@ def test_kao109_partition_loop_in_hot_modules():
     assert _rules(_lint(sup, rel="models/bounds.py")) == []
 
 
+# ---------------------------------------------------------------- KAO110
+
+POS_110_CAPTURE = """
+    def make_lane_stepper_fn(n_chains, lam):
+        def step(m, a, temp):
+            return a * lam  # config captured: specializes per config
+        return step
+"""
+
+POS_110_LOCAL = """
+    def make_lane_solver(cfg):
+        temp_scale = cfg.temp_scale
+        def solve(m, a, temps):
+            return a, temps * temp_scale
+        return solve
+"""
+
+POS_110_COERCE = """
+    def make_portfolio_stepper(m):
+        lam = float(m.lam)  # trace-time constant per config
+        def step(a):
+            return a
+        return step
+"""
+
+NEG_110_MODEL_DATA = """
+    def make_lane_stepper_fn(n_chains):
+        def step(m, a, temp):
+            # config as data: read off the model pytree inside the
+            # traced body — one executable serves every config
+            return a * m.lam + temp * m.temp_scale
+        return step
+"""
+
+NEG_110_SHADOWED = """
+    def make_thing(n):
+        def inner(lam):
+            return lam + n  # inner's OWN parameter, not a capture
+        return inner
+"""
+
+NEG_110_NOT_FACTORY = """
+    def summarize(m):
+        return float(m.lam)  # host provenance read, not a factory
+"""
+
+
+def test_kao110_lane_config_capture_in_factories():
+    assert "KAO110" in _rules(_lint(POS_110_CAPTURE))
+    assert "KAO110" in _rules(_lint(POS_110_LOCAL))
+    assert "KAO110" in _rules(_lint(POS_110_COERCE))
+    assert "KAO110" not in _rules(_lint(NEG_110_MODEL_DATA))
+    assert "KAO110" not in _rules(_lint(NEG_110_SHADOWED))
+    assert "KAO110" not in _rules(_lint(NEG_110_NOT_FACTORY))
+    # suppressible with justification, like every rule
+    sup = POS_110_CAPTURE.replace(
+        "return a * lam  # config captured: specializes per config",
+        "return a * lam  "
+        "# kao: disable=KAO110 -- fixture: deliberate specialization",
+    )
+    assert _rules(_lint(sup)) == []
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

@@ -136,9 +136,12 @@ def test_forced_midladder_pallas_fallback_parity(rng, monkeypatch,
     monkeypatch.setattr(plat, "ensure_backend", lambda: "tpu")
 
     real = pmesh.solve_on_mesh
+    real_lanes = pmesh.solve_lanes
     pallas_calls = {"n": 0}
 
-    def fake_solve_on_mesh(*args, **kw):
+    def _intercept(kw):
+        """Shared fallback simulation for both dispatch shapes (the
+        portfolio path ships chunks through solve_lanes)."""
         if kw.get("scorer") == "pallas":
             pallas_calls["n"] += 1
             if pallas_calls["n"] == 2:  # mid-ladder lowering failure
@@ -146,9 +149,16 @@ def test_forced_midladder_pallas_fallback_parity(rng, monkeypatch,
                     "Mosaic lowering failed (forced test fallback)"
                 )
             kw = dict(kw, scorer="xla")
-        return real(*args, **kw)
+        return kw
+
+    def fake_solve_on_mesh(*args, **kw):
+        return real(*args, **_intercept(kw))
+
+    def fake_solve_lanes(*args, **kw):
+        return real_lanes(*args, **_intercept(kw))
 
     monkeypatch.setattr(pmesh, "solve_on_mesh", fake_solve_on_mesh)
+    monkeypatch.setattr(pmesh, "solve_lanes", fake_solve_lanes)
 
     cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
     res = _solve(cluster, pipeline, "sweep", rounds=32)

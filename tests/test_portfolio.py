@@ -1,0 +1,313 @@
+"""Portfolio lanes (the PR-11 tentpole, docs/PORTFOLIO.md).
+
+Pins the contracts the portfolio dispatcher rests on:
+
+- config is DATA: a 1-lane portfolio carrying the default config is
+  bit-identical to the solo sweep solve (and a lane's trajectory does
+  not depend on how many other lanes race beside it), so one
+  lane-padded executable per bucket serves every config and width;
+- first-to-certify early exit is deterministic: under a forced
+  mid-ladder certificate the solve retires the ladder at the same
+  boundary with the same plan and the same winner-lane provenance on
+  every run;
+- the compound 2-move exchange accepts exactly the pair-atomic moves
+  it should (and nothing on config-disabled lanes), keeps every hard
+  invariant, and its carried-histogram deltas replay a from-scratch
+  rebuild bit-for-bit — through the XLA and Pallas-interpret scorer
+  bundles alike;
+- same-bucket portfolio solves share executables: the second solve
+  compiles nothing.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays, bucket
+from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+    _compound_sweep_delta,
+    _histograms,
+    make_sweep_solver_fn,
+    propose_compound,
+)
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _adv_instance(seed: int, **overrides):
+    kw = dict(n_brokers=32, n_topics_low=3, n_topics_high=3,
+              parts_per_topic=10, seed=seed)
+    kw.update(overrides)
+    sc = gen.adversarial(**kw)
+    return build_instance(sc.current, sc.broker_list, sc.topology)
+
+
+def _messy_instance(seed: int):
+    current, brokers, topo, target_rf = gen.messy_case(seed)
+    return build_instance(current, brokers, topo, target_rf)
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_default_config_lane_bit_identical_to_solo():
+    """A 1-lane portfolio with the default config replays the solo
+    sweep solve bit-for-bit — per-lane config arrays change nothing
+    until a config actually differs."""
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    seed = np.asarray(greedy_seed(inst), np.int32)
+    mesh = pm.make_mesh()
+    key = jax.random.PRNGKey(0)
+    temps = arrays.geometric_temps(2.0, 0.02, 16)
+
+    state = pm.init_sweep_state(m, jnp.asarray(seed), key, mesh, 2)
+    _st, ba1, bk1, cv1 = pm.solve_on_mesh(
+        m, None, None, mesh, 2, 16, 1, engine="sweep", temps=temps,
+        state=state,
+    )
+    stacked = arrays.stack_models(
+        [arrays.with_config(m, arrays.DEFAULT_CONFIG)]
+    )
+    _st2, ba2, bk2, cv2 = pm.solve_lanes(
+        stacked, mesh, 2, temps, lane_seeds=seed[None],
+        keys=jnp.stack([key]), engine="sweep",
+    )
+    np.testing.assert_array_equal(np.asarray(ba1), np.asarray(ba2)[:, 0])
+    np.testing.assert_array_equal(np.asarray(bk1), np.asarray(bk2)[:, 0])
+    np.testing.assert_array_equal(np.asarray(cv1), np.asarray(cv2)[:, 0])
+
+
+def test_lane_trajectories_independent_of_portfolio_width():
+    """Lane i's best plan is bit-identical whether 2 or 4 lanes race —
+    the vmap is element-wise and lane keys derive from the lane index,
+    never the width — which is what makes 'bit-identical winning plans
+    across portfolio widths' hold whenever the same lane wins."""
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    seed = np.asarray(greedy_seed(inst), np.int32)
+    mesh = pm.make_mesh()
+    key = jax.random.PRNGKey(3)
+    temps = arrays.geometric_temps(2.0, 0.02, 8)
+    cfgs = arrays.portfolio_configs(4)
+
+    outs = {}
+    for width in (2, 4):
+        stacked = arrays.stack_models(
+            [arrays.with_config(m, c) for c in cfgs[:width]]
+        )
+        keys = jnp.stack(
+            [key] + [jax.random.fold_in(key, i)
+                     for i in range(1, width)]
+        )
+        lane_seeds = np.stack([seed] * width)
+        outs[width] = pm.solve_lanes(
+            stacked, mesh, 2, temps, lane_seeds=lane_seeds, keys=keys,
+            engine="sweep",
+        )
+    for lane in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(outs[2][1])[:, lane],
+            np.asarray(outs[4][1])[:, lane],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(outs[2][2])[:, lane],
+            np.asarray(outs[4][2])[:, lane],
+        )
+
+
+def test_portfolio_configs_table():
+    cfgs = arrays.portfolio_configs(8)
+    assert cfgs[0] == arrays.DEFAULT_CONFIG  # lane 0 anchors the solo config
+    assert len({(c.lam, c.temp_scale, c.compound) for c in cfgs}) == 8
+    # cycling past the table is defined (no default reaches it)
+    assert arrays.portfolio_configs(10)[8] == cfgs[0]
+    # provenance round-trip (stats / flight records)
+    rt = arrays.model_config(
+        arrays.with_config(arrays.from_instance(_adv_instance(7)),
+                           cfgs[3])
+    )
+    assert rt == dataclasses.asdict(cfgs[3])
+
+
+# -------------------------------------------------- engine + early exit
+
+
+def test_engine_portfolio_stats_and_quality():
+    """The engine-level dispatcher: portfolio provenance lands in
+    stats, and at equal budget the portfolio closes the messy exact-band
+    case (gen.messy_case(1) — the instance that was the tier-1 xfail)
+    that the single default config cannot."""
+    inst = _messy_instance(1)
+    single = solve_tpu(inst, seed=1, engine="sweep", batch=8, rounds=32,
+                       portfolio=False)
+    port = solve_tpu(inst, seed=1, engine="sweep", batch=8, rounds=32,
+                     portfolio=True)
+    assert "portfolio" not in single.stats
+    p = port.stats["portfolio"]
+    assert p["width"] >= 2
+    assert p["lane_bucket"] >= p["width"]
+    assert port.stats["feasible"]
+    assert not single.stats["feasible"]  # the documented barrier
+    assert p["winner_lane"] is not None
+    assert p["winner_config"] == dataclasses.asdict(
+        arrays.portfolio_configs(p["width"])[p["winner_lane"]]
+    )
+
+
+def test_forced_midladder_certificate_early_exit_deterministic():
+    """A mid-ladder boundary certificate retires the portfolio ladder
+    first-to-certify: deterministically the same plan, the same winner
+    lane, and a recorded time-to-certificate, on every run."""
+    results = []
+    for _ in range(2):
+        inst = _adv_instance(9)
+        # force the boundary certificate: the move bound accepts any
+        # candidate and the weight bound is already met, so the FIRST
+        # feasible boundary winner certifies mid-ladder
+        inst.move_lower_bound_exact = lambda: 10**9
+        inst.weight_upper_bound = lambda tight=False: -1
+        res = solve_tpu(inst, seed=0, engine="sweep", batch=8,
+                        rounds=32, portfolio=True,
+                        cert_min_savings_s=0.0)
+        results.append(res)
+    a, b = results
+    assert a.stats["early_stopped"] and b.stats["early_stopped"]
+    pa, pb = a.stats["portfolio"], b.stats["portfolio"]
+    assert pa["early_exit"] and pb["early_exit"]
+    assert pa["winner_lane"] == pb["winner_lane"]
+    assert pa["winner_lane"] is not None
+    assert pa.get("certified_at_s") is not None
+    # the retired ladder ran fewer rounds than the full schedule
+    assert a.stats["rounds_run"] < 32
+    assert a.stats["rounds_run"] == b.stats["rounds_run"]
+    np.testing.assert_array_equal(a.a, b.a)
+
+
+def test_portfolio_shares_one_lane_executable_per_bucket():
+    """Two same-bucket portfolio solves dispatch ONE lane-padded
+    executable: the second compiles nothing (the exec-cache counters
+    are the acceptance evidence — docs/PORTFOLIO.md)."""
+    a = _adv_instance(11)
+    b = _adv_instance(12)
+    solve_tpu(a, seed=0, engine="sweep", batch=8, rounds=16,
+              portfolio=True)
+    before = bucket.STATS.snapshot()
+    res = solve_tpu(b, seed=1, engine="sweep", batch=8, rounds=16,
+                    portfolio=True)
+    after = bucket.STATS.snapshot()
+    assert res.stats["portfolio"]["width"] >= 2
+    assert after["compiles_total"] == before["compiles_total"], (
+        "a same-bucket portfolio solve recompiled the lane executable"
+    )
+
+
+# ------------------------------------------- compound 2-move exchange
+
+
+def _compound_fixture(seed=0, chains=2):
+    inst = _adv_instance(7)
+    m = arrays.from_instance(inst)
+    a = jnp.broadcast_to(
+        jnp.asarray(greedy_seed(inst), jnp.int32),
+        (chains, inst.num_parts, inst.max_rf),
+    )
+    _f, _r, cnt, lcnt, rcnt = _histograms(m, a)
+    return inst, m, a, cnt, lcnt, rcnt
+
+
+def test_compound_disabled_lane_declines_everything():
+    """A lane whose config turns the compound move off rejects every
+    proposal — the sweep itself still runs (one executable for every
+    config), it just never moves."""
+    inst, m, a, cnt, lcnt, rcnt = _compound_fixture()
+    m_off = arrays.with_config(
+        m, dataclasses.replace(arrays.DEFAULT_CONFIG, compound=False)
+    )
+    prop, _d, _lo = propose_compound(
+        m_off, a, jax.random.PRNGKey(0), jnp.float32(5.0), cnt, lcnt,
+        rcnt,
+    )
+    assert not bool(np.asarray(prop.prio > 0).any())
+    a2, c2, l2, r2 = _compound_sweep_delta(
+        m_off, a, cnt, lcnt, rcnt, jax.random.PRNGKey(0),
+        jnp.float32(5.0),
+    )
+    np.testing.assert_array_equal(np.asarray(a2), np.asarray(a))
+
+
+def test_compound_accepts_and_updates_histograms_exactly():
+    """At high temperature legal compound proposals are accepted, the
+    applied population keeps every hard invariant, and the carried
+    histogram deltas are bit-identical to a from-scratch rebuild."""
+    inst, m, a, cnt, lcnt, rcnt = _compound_fixture()
+    moved = False
+    accepted = False
+    key = jax.random.PRNGKey(1)
+    for _ in range(6):
+        key, sub = jax.random.split(key)
+        prop, _d, _lo = propose_compound(
+            m, a, sub, jnp.float32(500.0), cnt, lcnt, rcnt
+        )
+        a2, cnt2, lcnt2, rcnt2 = _compound_sweep_delta(
+            m, a, cnt, lcnt, rcnt, sub, jnp.float32(500.0)
+        )
+        accepted = accepted or bool(np.asarray(prop.prio > 0).any())
+        if (np.asarray(a2) != np.asarray(a)).any():
+            moved = True
+        # carried counts == from-scratch rebuild of the applied state
+        _f, _r, cr, lr, rr = _histograms(m, a2)
+        np.testing.assert_array_equal(np.asarray(cnt2), np.asarray(cr))
+        np.testing.assert_array_equal(np.asarray(lcnt2), np.asarray(lr))
+        np.testing.assert_array_equal(np.asarray(rcnt2), np.asarray(rr))
+        for n in range(a2.shape[0]):
+            v = inst.violations(np.asarray(a2)[n])
+            assert v["duplicate_in_partition"] == 0
+            assert v["null_in_valid_slot"] == 0
+            assert v["slot_out_of_range"] == 0
+        a, cnt, lcnt, rcnt = a2, cnt2, lcnt2, rcnt2
+    assert accepted  # accept coverage: proposals do get accepted
+    assert moved  # ... and the move set actually moves state
+
+
+def test_compound_low_temp_declines_penalized_pairs():
+    """Freeze-out decline coverage: at near-zero temperature with the
+    strict default lam, only delta >= 0 pairs survive — the applied
+    population can never score worse than it started."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        chain_scores,
+    )
+
+    inst, m, a, cnt, lcnt, rcnt = _compound_fixture()
+    w0, p0 = (np.asarray(x) for x in chain_scores(m, a))
+    a2, *_ = _compound_sweep_delta(
+        m, a, cnt, lcnt, rcnt, jax.random.PRNGKey(2), jnp.float32(1e-6)
+    )
+    w2, p2 = (np.asarray(x) for x in chain_scores(m, a2))
+    score0 = w0 - 64 * p0
+    score2 = w2 - 64 * p2
+    assert (score2 >= score0).all(), (score0, score2)
+
+
+def test_compound_schedule_xla_vs_pallas_interpret_bit_parity():
+    """The full sweep schedule — site, exchange, and compound sweeps —
+    through both scorer bundles yields byte-identical winners: the
+    compound step is shared code, and the bundles' surrounding stages
+    are pinned bit-compatible."""
+    inst = _adv_instance(8)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    temps = arrays.geometric_temps(2.0, 0.02, 8)  # sweeps 3 and 7 compound
+    outs = {}
+    for scorer in ("xla", "pallas-interpret"):
+        solve = jax.jit(make_sweep_solver_fn(n_chains=2, scorer=scorer))
+        ba, bk, _cv = solve(m, seed, jax.random.PRNGKey(5), temps)
+        outs[scorer] = (np.asarray(ba), int(bk))
+    np.testing.assert_array_equal(outs["xla"][0],
+                                  outs["pallas-interpret"][0])
+    assert outs["xla"][1] == outs["pallas-interpret"][1]

@@ -15,40 +15,16 @@ from kafka_assignment_optimizer_tpu.models.cluster import (
     PartitionAssignment,
     Topology,
 )
+# THE messy generator lives in gen (docs/PORTFOLIO.md): the bench
+# portfolio A/B consumes the same stream, so 'messy[1] was the tier-1
+# xfail' can never silently desynchronize from what bench measures
+from kafka_assignment_optimizer_tpu.utils.gen import (
+    messy_cluster as random_messy_cluster,
+)
 
 # soak tier (VERDICT r4 item 5): the property fuzz sweeps many random
 # clusters through full solves — release gate, not commit gate
 pytestmark = pytest.mark.soak
-
-
-def random_messy_cluster(rng):
-    """A deliberately irregular cluster: several topics with different
-    partition counts and RFs, a lopsided rack map, and a broker list
-    that both removes and adds brokers vs the current assignment."""
-    n_brokers = int(rng.integers(6, 16))
-    n_topics = int(rng.integers(1, 4))
-    parts = []
-    for t in range(n_topics):
-        rf = int(rng.integers(1, min(4, n_brokers) + 1))
-        for p in range(int(rng.integers(2, 9))):
-            reps = rng.choice(n_brokers, size=rf, replace=False)
-            parts.append(
-                PartitionAssignment(f"topic-{t}", p, [int(b) for b in reps])
-            )
-    # lopsided racks: rack 0 gets ~half the brokers, the rest spread
-    n_racks = int(rng.integers(1, 4))
-    add = int(rng.integers(0, 3))  # brand-new brokers joining
-    all_ids = list(range(n_brokers + add))
-    rack_of = {
-        b: f"rack{0 if b % 4 < 2 else (b % n_racks)}" for b in all_ids
-    }
-    drop = int(rng.integers(0, 2))
-    brokers = all_ids[drop:]  # maybe remove broker 0, maybe add new ones
-    target_rf = None
-    if rng.random() < 0.3:
-        target_rf = int(rng.integers(1, 4))  # global RF change
-    return (Assignment(partitions=parts), brokers,
-            Topology(rack_of=rack_of), target_rf)
 
 
 @pytest.mark.parametrize("case_seed", range(8))
@@ -76,21 +52,15 @@ def test_random_messy_clusters_all_constraints_hold(case_seed):
 
 @pytest.mark.parametrize("case_seed", [
     0,
-    # seed 1 builds an EXACT-band instance (rack_lo == rack_hi with one
-    # single-broker rack): reaching feasibility requires a coordinated
-    # two-move exchange whose intermediate state adds a violation, and
-    # with LAMBDA=64 vs t_hi=2.0 the sweep engine's accept probability
-    # for that step is ~e^-32 — the documented small-instance limitation
-    # the engine's defaulted-solve chain fallback exists for
-    # (engine.py "robustness net"); this test pins engine="sweep"
-    # deliberately, so the case is expected-fail, not broken — see
-    # docs/ANALYSIS.md (tier-1 triage)
-    pytest.param(1, marks=pytest.mark.xfail(
-        strict=False,
-        reason="exact-band instance needs a 2-move exchange the sweep "
-        "move set cannot accept; chain-engine fallback covers real "
-        "solves — docs/ANALYSIS.md (tier-1 triage)",
-    )),
+    # seed 1 builds an EXACT-band instance: reaching feasibility needs
+    # a coordinated 2-move exchange whose intermediate state adds a
+    # violation — at LAMBDA=64 a single default chain can never accept
+    # it. Closed by PR 11 (docs/PORTFOLIO.md, docs/ANALYSIS.md): the
+    # compound 2-move exchange evaluates the pair atomically, and the
+    # portfolio races diverse (lam, temp_scale) lanes — the winning
+    # low-lam lane tunnels where the default lane froze. Previously a
+    # triaged xfail; now a pass the portfolio must keep.
+    1,
     2,
     3,
 ])

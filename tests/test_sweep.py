@@ -199,7 +199,9 @@ def test_delta_stepper_bit_identical_to_from_scratch(rng):
     diverges the trajectory bit-visibly."""
     from kafka_assignment_optimizer_tpu.ops.score import moves_batch
     from kafka_assignment_optimizer_tpu.solvers.tpu.sweep import (
+        COMPOUND_EVERY,
         best_key,
+        compound_sweep,
         exchange_sweep,
         make_sweep_stepper_fn,
     )
@@ -220,7 +222,9 @@ def test_delta_stepper_bit_identical_to_from_scratch(rng):
     curve_r = []
     for i in range(sweeps):
         key_r, sub = jax.random.split(key_r)
-        if i % 2 == 1:
+        if i % COMPOUND_EVERY == COMPOUND_EVERY - 1:
+            a_r = compound_sweep(m, a_r, sub, temps[i])
+        elif i % 2 == 1:
             a_r = exchange_sweep(m, a_r, sub, temps[i])
         else:
             a_r = sweep_once(m, a_r, sub, temps[i])
