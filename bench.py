@@ -195,6 +195,7 @@ def _run_child(
     args: argparse.Namespace, name: str, env: dict, warmrun: bool,
     kernel: bool = False, batch_bench: bool = False,
     replay_day: bool = False, portfolio_bench: bool = False,
+    rollout_bench: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -211,6 +212,8 @@ def _run_child(
         cmd.append("--replay-day")
     if portfolio_bench:
         cmd.append("--portfolio-bench")
+    if rollout_bench:
+        cmd.append("--rollout-bench")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -890,6 +893,134 @@ def run_replay_day(smoke: bool, seed: int) -> dict:
     }
 
 
+def run_rollout_bench(smoke: bool, seed: int) -> dict:
+    """``--rollout-bench`` (docs/ROLLOUT.md, ISSUE 12): one full
+    supervised rollout through the watch registry + rollout manager on
+    the real delta-solve path. Reports waves-to-completion under tight
+    caps, the per-wave peak broker/rack transfer vs the caps —
+    recomputed independently off the move graph, not read back from
+    the packer's own accounting — and the re-plan latency after a
+    mid-rollout broker loss (the remaining waves re-packed against the
+    partially-moved ground truth)."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    import jax
+
+    from kafka_assignment_optimizer_tpu.api import optimize_delta
+    from kafka_assignment_optimizer_tpu.rollout.exec import RolloutManager
+    from kafka_assignment_optimizer_tpu.utils import gen
+    from kafka_assignment_optimizer_tpu.watch.manager import WatchRegistry
+
+    B = 12 if smoke else 48
+    n_racks = 4
+    ppt = 10 if smoke else 40
+    topics = {f"t{i}": ppt for i in range(4 if smoke else 12)}
+    rf = 3
+    brokers = list(range(B))
+    topo = gen._mod_topology(brokers, n_racks)
+    current = gen.balanced_assignment(brokers, topo, topics, rf)
+    limit_s = 60.0 if smoke else 300.0
+
+    def solve_fn(state, prev_plan, budget):
+        res = optimize_delta(
+            state.assignment, state.brokers, state.topology,
+            target_rf=state.rf, prev_plan=prev_plan, solver="auto",
+            seed=seed, time_limit_s=limit_s,
+        )
+        return res.assignment.to_dict(), res.report()
+
+    reg = WatchRegistry(solve_fn, None, window_s=0.0)
+    broker_cap, rack_cap = (3, 8) if smoke else (6, 16)
+    mgr = RolloutManager(reg, None, broker_cap=broker_cap,
+                         rack_cap=rack_cap)
+    cid = "rollout-bench"
+    reg.handle_event(cid, {
+        "type": "bootstrap", "epoch": 1,
+        "assignment": current.to_dict(), "brokers": brokers,
+        "topology": topo.to_dict(), "rf": rf,
+    })
+    # the day's work: decommission two brokers -> a plan with real moves
+    reg.handle_event(cid, {"type": "broker_drain", "epoch": 2,
+                           "brokers": [B - 1, B - 2]})
+
+    t0 = time.perf_counter()
+    view = mgr.command(cid, "start", {"epoch": 1})
+    pack_s = time.perf_counter() - t0
+    waves_planned = view["waves"]
+
+    def wave_caps_ok() -> tuple[bool, int, int]:
+        """Recompute every wave's peak loads from its own move graph
+        (adds + sources against the live topology) and check the caps
+        the record claims."""
+        v = mgr.get(cid)
+        t = reg.topology_of(cid)
+        rack = (t.rack if t is not None else (lambda b: "r0"))
+        rec = mgr._records[cid]
+        peak_b = peak_r = 0
+        ok = True
+        for w in rec.plan.waves:
+            bl, rl = {}, {}
+            for m in w.moves:
+                for b in m.adds:
+                    bl[b] = bl.get(b, 0) + 1
+                    r = rack(b)
+                    rl[r] = rl.get(r, 0) + 1
+                    if m.source is not None:
+                        bl[m.source] = bl.get(m.source, 0) + 1
+            wb = max(bl.values(), default=0)
+            wr = max(rl.values(), default=0)
+            peak_b, peak_r = max(peak_b, wb), max(peak_r, wr)
+            ok = ok and wb <= v["caps"]["broker"] \
+                and wr <= v["caps"]["rack"]
+        return ok, peak_b, peak_r
+
+    ep = 2
+    view = mgr.command(cid, "advance", {"epoch": ep})            # canary
+    ep += 1
+    view = mgr.command(cid, "advance", {"epoch": ep,
+                                        "canary_ok": True})
+    ep += 1
+    # mid-rollout broker loss: the watch channel re-solves against the
+    # partially-moved truth and the rollout re-packs the REMAINING
+    # waves — this wall clock IS the re-plan latency
+    t1 = time.perf_counter()
+    reg.handle_event(cid, {"type": "broker_remove", "epoch": 3,
+                           "brokers": [0]})
+    replan_s = time.perf_counter() - t1
+    caps_ok, peak_b, peak_r = wave_caps_ok()
+    view = mgr.get(cid)
+    while view["status"] in ("canary", "advancing"):
+        p = {"epoch": ep}
+        if view["status"] == "canary":
+            p["canary_ok"] = True
+        view = mgr.command(cid, "advance", p)
+        ep += 1
+    total_s = time.perf_counter() - t0
+    info = reg.get_cluster(cid)
+    return {
+        "platform": jax.devices()[0].platform,
+        "brokers": B,
+        "partitions": sum(topics.values()),
+        "waves_planned": waves_planned,
+        "waves_applied": len(view["applied"]),
+        "replans": view["replans"],
+        "broker_cap": view["caps"]["broker"],
+        "rack_cap": view["caps"]["rack"],
+        "peak_broker": peak_b,
+        "peak_rack": peak_r,
+        "caps_ok": caps_ok,
+        "terminal": view["status"],
+        "terminal_ok": (
+            view["status"] == "done"
+            and info["assignment"] == info["plan"]
+        ),
+        "pack_s": round(pack_s, 4),
+        "replan_s": round(replan_s, 4),
+        "total_s": round(total_s, 4),
+    }
+
+
 def run_kernel_bench(smoke: bool) -> dict:
     """Time the Pallas scoring kernel (compiled, interpret=False) against
     the pure-XLA scorer on a production-shaped batch. TPU-only: on CPU
@@ -910,6 +1041,10 @@ def child_main(args: argparse.Namespace) -> int:
         return 0
     if args.portfolio_bench:
         out = run_portfolio_ab(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
+    if args.rollout_bench:
+        out = run_rollout_bench(args.smoke, args.seed)
         print("RESULT " + json.dumps(out))
         return 0
     out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
@@ -1024,6 +1159,21 @@ def _compact_portfolio(rp: dict | None, err: str | None) -> dict:
         "wall_p50_portfolio_s": p["wall_p50_s"],
         "compiles_portfolio_arm": rp["compiles_portfolio_arm"],
     }
+
+
+def _compact_rollout(rr: dict | None, err: str | None) -> dict:
+    """The rollout block of the stdout line: waves to completion, the
+    independently-recomputed per-wave peaks vs caps, the mid-rollout
+    re-plan latency, and the terminal verdict — the ISSUE 12 bench
+    evidence, compare-gated by obs/regress.py."""
+    if rr is None:
+        return {"error": (err or "failed")[:120]}
+    return {k: rr[k] for k in (
+        "waves_planned", "waves_applied", "replans",
+        "broker_cap", "rack_cap", "peak_broker", "peak_rack",
+        "caps_ok", "terminal", "terminal_ok",
+        "pack_s", "replan_s", "total_s",
+    )}
 
 
 def _compact_kernel(k: dict) -> dict:
@@ -1241,6 +1391,18 @@ def main() -> int:
                          "step's entry; same exclusive convention as "
                          "--replay-day). The full default sweep runs "
                          "the same harness automatically as an extra.")
+    ap.add_argument("--rollout-bench", action="store_true",
+                    help="run ONLY the streaming-rollout harness "
+                         "(docs/ROLLOUT.md): one supervised rollout "
+                         "through the watch registry on the real "
+                         "delta-solve path — waves-to-completion "
+                         "under tight caps, per-wave peak broker/rack "
+                         "transfer vs cap recomputed off the move "
+                         "graph, and the re-plan latency after a "
+                         "mid-rollout broker loss; emitted as a "
+                         "one-line rollout artifact wired into "
+                         "--compare regression keys (same exclusive "
+                         "convention as --replay-day)")
     ap.add_argument("--replay-day", action="store_true",
                     help="run ONLY the event-day replay harness "
                          "(docs/WATCH.md): a scripted day of cluster "
@@ -1280,6 +1442,27 @@ def main() -> int:
         line = {"metric": "replay_day", "platform": platform,
                 "env": _env_stamp(platform, ndev, env),
                 **_compact_replay(rb, eb)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
+
+    if args.rollout_bench:
+        # standalone rollout harness (the soak rollout step's entry):
+        # one child, one dedicated stdout line — no scenario sweep
+        try:
+            env, platform, tpu_err, ndev = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "rollout_bench",
+                              "error": repr(e)[:300]}))
+            return 0
+        rr, er = _run_child(args, "rollout_bench", env, warmrun=False,
+                            rollout_bench=True)
+        if rr is not None:
+            print("[bench] ROLLOUT " + json.dumps(rr), file=sys.stderr)
+        line = {"metric": "rollout_bench", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
+                "rollout": _compact_rollout(rr, er)}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
         print(json.dumps(line))

@@ -108,6 +108,42 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the lp_solve LP-format equation file (README.md:144-185)",
     )
     ap.add_argument(
+        "--emit-waves",
+        metavar="DIR",
+        help="streaming rollout (docs/ROLLOUT.md): also decompose the "
+        "plan into bandwidth-budgeted move waves and write one "
+        "reassignment JSON file per wave (wave-000.json, ...) under "
+        "DIR — each file byte-compatible with the plan output schema "
+        "(README.md:52-78), applied in file order; within a wave, "
+        "leader-changing moves come last",
+    )
+    ap.add_argument(
+        "--wave-broker-cap",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--emit-waves: per-wave transfer cap per broker in "
+        "transfer units (replica copies in + out; default 4, raised "
+        "to the largest single move when below it)",
+    )
+    ap.add_argument(
+        "--wave-rack-cap",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--emit-waves: per-wave inbound transfer cap per rack "
+        "(default 16)",
+    )
+    ap.add_argument(
+        "--wave-packer",
+        choices=["greedy", "scored"],
+        default=None,
+        help="--emit-waves: wave packer (default greedy; 'scored' "
+        "races diverse move orderings and keeps the packing "
+        "minimizing makespan x peak cross-rack traffic; same as "
+        "KAO_ROLLOUT_PACKER)",
+    )
+    ap.add_argument(
         "--evaluate",
         metavar="PLAN.json",
         help="audit an existing plan instead of solving: print its "
@@ -275,6 +311,14 @@ def _run(args: argparse.Namespace) -> int:
             topology,
             target_rf=target_rf,
         )
+        if args.emit_waves:
+            # waves for an AUDITED plan (ours or another tool's): the
+            # same current -> plan decomposition the solve path emits
+            rep["waves"] = _emit_waves(
+                args, current,
+                Assignment.from_json(Path(args.evaluate).read_text()),
+                topology,
+            )
         out = json.dumps(rep, indent=args.indent, default=str)
         if args.output:
             Path(args.output).write_text(out + "\n")
@@ -317,6 +361,11 @@ def _run(args: argparse.Namespace) -> int:
 
         Path(args.emit_lp).write_text(emit_lp(res.instance))
 
+    wave_summary = None
+    if args.emit_waves:
+        wave_summary = _emit_waves(args, current, res.assignment,
+                                   topology)
+
     out = res.assignment.to_json(indent=args.indent)
     if args.output:
         Path(args.output).write_text(out + "\n")
@@ -326,10 +375,54 @@ def _run(args: argparse.Namespace) -> int:
     rep = res.report()
     if args.trace and "solve_report" in res.solve.stats:
         rep["solve_report"] = res.solve.stats["solve_report"]
+    if wave_summary is not None:
+        rep["waves"] = wave_summary
     if args.report or args.trace:
         # kao: disable=KAO106 -- --report's stderr JSON is the CLI's UX contract
         print(json.dumps(rep, indent=2, default=str), file=sys.stderr)
     return 0 if rep["feasible"] else 3
+
+
+def _emit_waves(args: argparse.Namespace, current, plan_assignment,
+                topology) -> dict:
+    """``--emit-waves DIR``: write one upstream-compatible reassignment
+    JSON file per bandwidth-budgeted wave (docs/ROLLOUT.md). File order
+    is application order; each file is the exact dialect
+    ``kafka-reassign-partitions --execute`` accepts, so an operator can
+    feed the waves to the stock tooling one at a time."""
+    from .rollout.exec import wave_json
+    from .rollout.waves import (
+        DEFAULT_BROKER_CAP,
+        DEFAULT_RACK_CAP,
+        WaveCaps,
+        pack_waves,
+    )
+
+    caps = WaveCaps(
+        broker=(args.wave_broker_cap if args.wave_broker_cap is not None
+                else DEFAULT_BROKER_CAP),
+        rack=(args.wave_rack_cap if args.wave_rack_cap is not None
+              else DEFAULT_RACK_CAP),
+    )
+    plan = pack_waves(current, plan_assignment, topology, caps=caps,
+                      packer=args.wave_packer, seed=args.seed or 0)
+    outdir = Path(args.emit_waves)
+    outdir.mkdir(parents=True, exist_ok=True)
+    files = []
+    for w in plan.waves:
+        path = outdir / f"wave-{w.index:03d}.json"
+        path.write_text(json.dumps(wave_json(w), indent=2) + "\n")
+        files.append(path.name)
+    return {
+        "dir": str(outdir),
+        "files": files,
+        "makespan": plan.makespan,
+        "caps": plan.caps.to_dict(),
+        "packer": plan.packer,
+        "peak_broker": plan.peak_broker,
+        "peak_rack": plan.peak_rack,
+        "peak_cross_rack": plan.peak_cross_rack,
+    }
 
 
 def _run_events(args: argparse.Namespace) -> int:

@@ -104,6 +104,38 @@ def request_schema() -> dict:
                             "reason=event_storm: backpressure with "
                             "Retry-After",
             },
+            "POST /clusters/<id>/rollout/{start,advance,pause,rollback}": {
+                "request": {
+                    "epoch": "rollout-command epoch (required): a "
+                             "non-negative int, strictly greater than "
+                             "the rollout's current epoch (stale -> "
+                             "structured 409, store untouched)",
+                    "broker_cap": "start only: per-wave transfer cap "
+                                  "per broker in transfer units "
+                                  "(replica copies in + out); default "
+                                  "from --rollout-broker-cap",
+                    "rack_cap": "start only: per-wave inbound cap per "
+                                "rack; default from --rollout-rack-cap",
+                    "packer": "start only: 'greedy' | 'scored' "
+                              "(docs/ROLLOUT.md)",
+                    "canary_ok": "advance past the canary wave only: "
+                                 "true applies it and advances, false "
+                                 "rolls the rollout back",
+                },
+                "response": {
+                    "200": "the rollout view: status (planned|canary|"
+                           "advancing|paused|done|rolled_back), "
+                           "wave_index, per-wave transfer accounting, "
+                           "and current_wave as upstream-compatible "
+                           "reassignment JSON",
+                    "409": "stale rollout epoch or a command the state "
+                           "machine cannot accept",
+                },
+            },
+            "GET /clusters/<id>/rollout": "the rollout record: wave "
+                                          "schedule, caps, applied "
+                                          "waves, replans, and the "
+                                          "current wave JSON",
             "GET /clusters": "watched clusters + delta-API counters; "
                              "/clusters/<id> returns one cluster's "
                              "state, epoch, and last certified plan",
@@ -187,7 +219,11 @@ For clusters that change over time, the delta API
 (<code>POST /clusters/&lt;id&gt;/events</code>) remembers each named
 cluster's last certified plan and re-solves incrementally per
 epoch-fenced change event — broker add/remove/drain, rack failure,
-partition growth, RF change (docs/WATCH.md).</p>
+partition growth, RF change (docs/WATCH.md). Execute a certified plan
+as bandwidth-budgeted move waves with canary gating and bit-exact
+rollback via
+<code>POST /clusters/&lt;id&gt;/rollout/{{start,advance,pause,rollback}}</code>
+(docs/ROLLOUT.md).</p>
 
 <h2>Extended example (live)</h2>
 <p>Prefilled with the worked demo: a 20-broker cluster spread over two

@@ -212,6 +212,9 @@ def _latency_pairs(old: dict, new: dict) -> list[tuple[str, float, float]]:
     for k in ("ttfc_p50_s", "wall_p50_single_s",
               "wall_p50_portfolio_s"):
         add(f"portfolio_ab.{k}", opa.get(k), npa.get(k))
+    oro, nro = old.get("rollout") or {}, new.get("rollout") or {}
+    for k in ("pack_s", "replan_s", "total_s"):
+        add(f"rollout.{k}", oro.get(k), nro.get(k))
     return pairs
 
 
@@ -235,6 +238,31 @@ def _throughput_pairs(old: dict,
             orows[sc].get("pipeline_speedup"),
             nrows[sc].get("pipeline_speedup"))
     return pairs
+
+
+# deterministic verdict keys per artifact block: their PRESENCE in both
+# artifacts counts as a performed check (see compare() — an artifact
+# whose only numbers sit under the latency noise floor, like the smoke
+# rollout bench, is still genuinely compared on these), and their
+# regression logic lives in _quality_regressions
+_DETERMINISTIC_KEYS = (
+    ("replay_day", ("quality_ok", "storm_dropped")),
+    ("portfolio_ab", ("quality_win", "feasible_portfolio",
+                      "worst_viol_portfolio")),
+    ("batch_throughput", ("lanes_feasible", "moves_at_bound")),
+    ("rollout", ("caps_ok", "terminal_ok")),
+)
+
+
+def _quality_checks(old: dict, new: dict) -> int:
+    """How many deterministic verdict keys are present in BOTH
+    artifacts — the denominator that keeps a quality-only artifact
+    from reading as 'nothing compared'."""
+    n = 0
+    for block, keys in _DETERMINISTIC_KEYS:
+        ob, nb = old.get(block) or {}, new.get(block) or {}
+        n += sum(1 for k in keys if k in ob and k in nb)
+    return n
 
 
 def _quality_regressions(old: dict, new: dict) -> list[dict]:
@@ -299,6 +327,15 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
             and nw > ow):
         regs.append({"metric": "portfolio_ab.worst_viol_portfolio",
                      "old": ow, "new": nw})
+    # streaming-rollout quality (docs/ROLLOUT.md): the cap contract and
+    # the terminal verdict are deterministic — a wave exceeding its
+    # transfer cap or a rollout failing to terminate cleanly is a
+    # confirmed regression, never annealer luck
+    oro, nro = old.get("rollout") or {}, new.get("rollout") or {}
+    for k in ("caps_ok", "terminal_ok"):
+        if oro.get(k) is True and nro.get(k) is False:
+            regs.append({"metric": f"rollout.{k}",
+                         "old": True, "new": False})
     return regs
 
 
@@ -352,7 +389,8 @@ def compare(old: dict, new: dict, *,
 
     quality = _quality_regressions(old, new)
     n_checked = len(lat) + len(thr)
-    if n_checked == 0 and not quality:
+    n_quality = _quality_checks(old, new)
+    if n_checked == 0 and n_quality == 0 and not quality:
         # nothing was comparable (disjoint scenario sets, stripped
         # artifacts): an empty check list must not read as a green
         # gate
@@ -368,6 +406,7 @@ def compare(old: dict, new: dict, *,
         "comparable": True,
         "verdict": "regression" if regression else "ok",
         "checked": n_checked,
+        "checked_quality": n_quality,
         "suspect_quorum": quorum,
         "latency": {
             "confirmed": confirmed,

@@ -77,6 +77,17 @@ Endpoints:
     Watched-cluster listing / one cluster's state, epoch, and last
     certified plan.
 
+``GET /clusters/<id>/rollout`` /
+``POST /clusters/<id>/rollout/{start,advance,pause,rollback}``
+    Streaming plan rollout (docs/ROLLOUT.md): execute the cluster's
+    certified plan as bandwidth-budgeted move waves — no broker or
+    rack exceeds a per-wave transfer cap — with canary verification
+    gating advancement, epoch-fenced commands (stale -> structured
+    409 without touching the store), bit-exact rollback via inverse
+    waves, and mid-rollout cluster events re-planning the REMAINING
+    waves against the partially-moved ground truth. Each wave is
+    emitted as upstream-compatible reassignment JSON.
+
 ``GET /healthz``
     ``{"status": "ok", "solvers": [...], "platform": "tpu",
     "cache": {...bucket/executable counters...}, "queue": {...}}``
@@ -137,6 +148,9 @@ from .resilience import breaker as _breaker
 from .resilience import budget as _rbudget
 from .resilience import chaos as _chaos
 from .resilience import ladder as _ladder
+from .rollout import exec as _rexec
+from .rollout import state as _rstate
+from .rollout import waves as _rwaves
 from .watch import events as _wevents
 from .watch import manager as _wmanager
 from .watch import store as _wstore
@@ -208,6 +222,27 @@ WATCH = {
     "lock_wait_s": DEFAULT_LOCK_WAIT_S,
     "max_solve_s": DEFAULT_MAX_SOLVE_S,
 }
+
+# streaming plan rollout (docs/ROLLOUT.md): GET /clusters/<id>/rollout
+# + POST /clusters/<id>/rollout/{start,advance,pause,rollback}. The
+# manager rides the watch registry (same plan store, same solve path
+# for mid-rollout re-plans) and is rebuilt whenever the registry is —
+# tests that reset WATCH["registry"] get a fresh manager for free.
+ROLLOUT = {
+    "manager": None,
+    "broker_cap": _rwaves.DEFAULT_BROKER_CAP,
+    "rack_cap": _rwaves.DEFAULT_RACK_CAP,
+    "packer": "greedy",
+    "lanes": _rwaves.DEFAULT_LANES,
+}
+# the kao_rollout_* counter families, pre-declared at zero so
+# dashboards see them before the first rollout (the PR 6
+# removed-but-referenced KeyError discipline)
+_ROLLOUT_COUNTER_NAMES = (
+    "started_total", "commands_total", "fenced_total",
+    "waves_emitted_total", "waves_applied_total", "canary_fail_total",
+    "rollbacks_total", "replans_total", "completed_total", "active",
+)
 
 # circuit breaker on repeated solver failures per bucket key
 # (resilience.breaker): a bucket that keeps failing compile/dispatch
@@ -828,6 +863,18 @@ def render_metrics() -> str:
         })
     for k, v in watch_zeroes.items():
         snap[f"watch_{k}"] = v
+    # streaming plan rollout counters (docs/ROLLOUT.md): the full
+    # family set is pre-declared at zero; the live manager (built on
+    # first rollout touch — never by a scrape) overlays its counts
+    rollout_zeroes = {k: 0 for k in _ROLLOUT_COUNTER_NAMES}
+    rmgr = ROLLOUT.get("manager")
+    if rmgr is not None:
+        rollout_zeroes.update({
+            k: v for k, v in rmgr.snapshot().items()
+            if isinstance(v, (int, float)) and k in rollout_zeroes
+        })
+    for k, v in rollout_zeroes.items():
+        snap[f"rollout_{k}"] = v
     # resilience gauges (docs/RESILIENCE.md): circuit-breaker state and
     # whether a chaos spec is armed (a production scrape showing
     # kao_chaos_armed 1 is itself an alert)
@@ -1786,6 +1833,85 @@ def handle_cluster_event(
     return status, out
 
 
+def _rollout_manager() -> _rexec.RolloutManager:
+    """The process's one rollout manager, lazily built over the current
+    watch registry (and rebuilt when tests swap the registry out)."""
+    reg = _watch_registry()
+    mgr = ROLLOUT.get("manager")
+    if mgr is None or mgr.registry is not reg:
+        mgr = _rexec.RolloutManager(
+            reg, reg.store,
+            broker_cap=ROLLOUT["broker_cap"],
+            rack_cap=ROLLOUT["rack_cap"],
+            packer=ROLLOUT["packer"],
+            lanes=ROLLOUT["lanes"],
+            trace=bool(OBS["trace"]),
+        )
+        ROLLOUT["manager"] = mgr
+    return mgr
+
+
+def handle_rollout_get(cluster_id: str) -> dict:
+    """GET /clusters/<id>/rollout — the rollout record: status, wave
+    schedule + per-wave transfer accounting, and the current wave as
+    upstream-compatible reassignment JSON."""
+    try:
+        view = _rollout_manager().get(cluster_id)
+    except (_wevents.EventError, ValueError) as e:
+        raise ApiError(400, str(e)) from e
+    if view is None:
+        raise ApiError(
+            404,
+            f"no rollout for cluster {cluster_id!r}; start one with "
+            "POST /clusters/<id>/rollout/start",
+        )
+    return view
+
+
+def handle_rollout_command(
+    cluster_id: str,
+    cmd: str,
+    payload: dict,
+    *,
+    lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
+) -> dict:
+    """POST /clusters/<id>/rollout/{start,advance,pause,rollback} —
+    one fenced rollout command (docs/ROLLOUT.md). 400 malformed, 404
+    unknown cluster, 409 stale rollout epoch (structured, provably
+    without touching the store) or a command the state machine cannot
+    accept, 200 with the updated rollout view (including the current
+    wave's reassignment JSON) otherwise."""
+    mgr = _rollout_manager()
+    budget = _rbudget.Budget(lock_wait_s)
+    try:
+        return mgr.command(cluster_id, cmd, payload, budget=budget)
+    except _rstate.RolloutFenced as e:
+        raise ApiError(
+            409,
+            str(e),
+            body={
+                "reason": "stale_rollout_epoch",
+                "cluster_id": e.cluster_id,
+                "epoch": e.got,
+                "current_rollout_epoch": e.current,
+                "expected_min_epoch": e.current + 1,
+            },
+        ) from e
+    except _rstate.RolloutConflict as e:
+        raise ApiError(
+            409, str(e), body={"reason": "bad_state"},
+        ) from e
+    except _rstate.RolloutError as e:
+        raise ApiError(400, str(e)) from e
+    except _wevents.EventError as e:
+        raise ApiError(404, str(e)) from e
+    except ApiError:
+        raise
+    except (ValueError, KeyError) as e:
+        msg = e.args[0] if e.args and isinstance(e.args[0], str) else str(e)
+        raise ApiError(422, f"rollout rejected: {msg}") from e
+
+
 def handle_clusters_get(cluster_id: str | None = None) -> dict:
     """GET /clusters (listing) and GET /clusters/<id> (state + last
     certified plan)."""
@@ -1866,6 +1992,7 @@ def handle_healthz() -> dict:
             "queue_wait_s": _SOLVES.queue_wait_s,
         },
         "watch": _healthz_watch(),
+        "rollout": _healthz_rollout(),
     }
 
 
@@ -1880,6 +2007,8 @@ def _healthz_portfolio() -> dict:
     from .solvers.tpu.arrays import portfolio_configs
     from .solvers.tpu.engine import portfolio_width_default
 
+    from .solvers.tpu.arrays import portfolio_adapt_snapshot
+
     width = portfolio_width_default()
     return {
         "enabled": width > 1,
@@ -1888,6 +2017,9 @@ def _healthz_portfolio() -> dict:
         "configs": [
             _dc.asdict(c) for c in portfolio_configs(width)
         ] if width > 1 else [],
+        # adaptive table evidence (ISSUE 12 satellite): wins per table
+        # slot and the order currently racing (KAO_PORTFOLIO_ADAPT)
+        "adapt": portfolio_adapt_snapshot(),
     }
 
 
@@ -1920,6 +2052,16 @@ def _healthz_watch() -> dict:
         return {"dir": WATCH["dir"], **_watch_registry().snapshot()}
     except Exception as e:  # pragma: no cover - post-boot dir breakage
         return {"dir": WATCH["dir"], "error": repr(e)[:200]}
+
+
+def _healthz_rollout() -> dict:
+    """The /healthz rollout section — same degrade-to-error discipline
+    as the watch section (the manager's lazy build touches the plan
+    store)."""
+    try:
+        return _rollout_manager().snapshot()
+    except Exception as e:  # pragma: no cover - post-boot dir breakage
+        return {"error": repr(e)[:200]}
 
 
 def _synthetic_cluster(brokers: int, partitions: int, rf: int,
@@ -2308,6 +2450,21 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif route == "/clusters":
             self._send(200, handle_clusters_get())
+        elif route.startswith("/clusters/") \
+                and route.endswith("/rollout") \
+                and len(route) > len("/clusters//rollout"):
+            # the length guard keeps a cluster legitimately NAMED
+            # "rollout" readable: GET /clusters/rollout has no cluster
+            # segment before the suffix and falls through to the
+            # normal cluster view below
+            try:
+                self._send(200, handle_rollout_get(
+                    route[len("/clusters/"):-len("/rollout")]
+                ))
+            except ApiError as e:
+                if e.status != 503:
+                    _count(errors_total=1)
+                self._send(e.status, {"error": str(e), **e.body_extra})
         elif route.startswith("/clusters/"):
             try:
                 self._send(200, handle_clusters_get(
@@ -2366,8 +2523,18 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         route = self._route()
         cluster_id = None
-        if route.startswith("/clusters/") and route.endswith("/events"):
-            cluster_id = route[len("/clusters/"):-len("/events")]
+        rollout_cmd = None
+        if route.startswith("/clusters/"):
+            rest = route[len("/clusters/"):]
+            if rest.endswith("/events"):
+                cluster_id = rest[: -len("/events")]
+            else:
+                for cmd in ("start", "advance", "pause", "rollback"):
+                    suffix = "/rollout/" + cmd
+                    if rest.endswith(suffix):
+                        cluster_id = rest[: -len(suffix)]
+                        rollout_cmd = cmd
+                        break
         if route not in ("/submit", "/evaluate", "/warmup") \
                 and cluster_id is None:
             _count(errors_total=1)
@@ -2406,6 +2573,12 @@ class Handler(BaseHTTPRequestHandler):
                 self._send(200, handle_warmup(
                     payload, lock_wait_s=lock_wait_s,
                     max_solve_s=max_solve_s,
+                ))
+                return
+            if rollout_cmd is not None:
+                self._send(200, handle_rollout_command(
+                    cluster_id, rollout_cmd, payload,
+                    lock_wait_s=lock_wait_s,
                 ))
                 return
             if cluster_id is not None:
@@ -2580,6 +2753,26 @@ def main(argv: list[str] | None = None) -> int:
                          "shed with 503 reason=event_storm and a "
                          "Retry-After derived from the coalescing "
                          "window; admitted events are never dropped")
+    ap.add_argument("--rollout-broker-cap", type=int,
+                    default=_rwaves.DEFAULT_BROKER_CAP, metavar="N",
+                    help="streaming plan rollout (docs/ROLLOUT.md): "
+                         "default per-wave transfer cap per broker, in "
+                         "transfer units (replica copies in + out); a "
+                         "rollout start may override per rollout")
+    ap.add_argument("--rollout-rack-cap", type=int,
+                    default=_rwaves.DEFAULT_RACK_CAP, metavar="N",
+                    help="default per-wave inbound transfer cap per "
+                         "rack (docs/ROLLOUT.md)")
+    ap.add_argument("--rollout-packer", default="greedy",
+                    choices=["greedy", "scored"],
+                    help="default wave packer: 'greedy' (host "
+                         "reference, first-fit-decreasing) or 'scored' "
+                         "(races diverse move orderings and keeps the "
+                         "packing minimizing makespan x peak cross-"
+                         "rack traffic; same as KAO_ROLLOUT_PACKER)")
+    ap.add_argument("--rollout-lanes", type=int,
+                    default=_rwaves.DEFAULT_LANES, metavar="N",
+                    help="orderings the scored packer races (>= 1)")
     ap.add_argument("--breaker-threshold", type=int, default=3,
                     metavar="N",
                     help="consecutive solver failures on one bucket "
@@ -2710,6 +2903,17 @@ def main(argv: list[str] | None = None) -> int:
     WATCH["lock_wait_s"] = args.lock_wait_s
     WATCH["max_solve_s"] = args.max_solve_s or None
     WATCH["registry"] = None  # rebuilt lazily with this config
+    if args.rollout_broker_cap < 1:
+        ap.error("--rollout-broker-cap must be >= 1")
+    if args.rollout_rack_cap < 1:
+        ap.error("--rollout-rack-cap must be >= 1")
+    if args.rollout_lanes < 1:
+        ap.error("--rollout-lanes must be >= 1")
+    ROLLOUT["broker_cap"] = args.rollout_broker_cap
+    ROLLOUT["rack_cap"] = args.rollout_rack_cap
+    ROLLOUT["packer"] = args.rollout_packer
+    ROLLOUT["lanes"] = args.rollout_lanes
+    ROLLOUT["manager"] = None  # rebuilt lazily over the new registry
     _BREAKER.configure(threshold=args.breaker_threshold,
                        cooldown_s=args.breaker_cooldown_s)
     if args.chaos:

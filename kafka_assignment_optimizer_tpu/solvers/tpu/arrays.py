@@ -15,6 +15,8 @@ high-temperature sweeps tunnel through infeasible states.
 from __future__ import annotations
 
 import dataclasses
+import os as _os
+import threading as _threading
 from dataclasses import dataclass
 
 import jax
@@ -72,11 +74,99 @@ PORTFOLIO_TABLE = (
 )
 
 
+# --------------------------------------------------------------------------
+# adaptive portfolio table (ISSUE 12 satellite; the PR-11 follow-on
+# named in ROADMAP item 3). kao_portfolio_winner_total is the evidence
+# stream: a lane config that NEVER wins is a device slot the diversity
+# table should respend. Env-gated — KAO_PORTFOLIO_ADAPT=1 reorders the
+# table once enough evidence exists (winners first, never-winners
+# demoted toward the tail, where widths below the table length drop
+# them); with the gate off the table is PINNED to the static order
+# above, bit-for-bit, so default solves stay reproducible.
+# --------------------------------------------------------------------------
+
+_ADAPT_LOCK = _threading.Lock()
+_ADAPT_WINS = [0] * len(PORTFOLIO_TABLE)
+_ADAPT_SOLVES = [0]
+# evidence floor: below this many portfolio solves the table never
+# reorders, even with the gate on — a single lucky win must not
+# reshuffle the race
+ADAPT_MIN_SOLVES = 16
+
+
+def portfolio_adapt_enabled() -> bool:
+    return _os.environ.get("KAO_PORTFOLIO_ADAPT", "").lower() not in (
+        "", "0", "false", "no",
+    )
+
+
+def note_portfolio_result(winner: LaneConfig | None) -> None:
+    """One finished portfolio solve: ``winner`` is the lane config that
+    produced the final plan (None when no lane won outright — e.g. the
+    constructor's plan was adopted). The engine calls this once per
+    portfolio solve, gate on or off, so evidence is already banked when
+    an operator flips KAO_PORTFOLIO_ADAPT on."""
+    with _ADAPT_LOCK:
+        _ADAPT_SOLVES[0] += 1
+        if winner is not None:
+            try:
+                _ADAPT_WINS[PORTFOLIO_TABLE.index(winner)] += 1
+            except ValueError:
+                pass  # a custom config outside the table: no slot
+
+
+def reset_portfolio_adapt() -> None:
+    with _ADAPT_LOCK:
+        _ADAPT_SOLVES[0] = 0
+        for i in range(len(_ADAPT_WINS)):
+            _ADAPT_WINS[i] = 0
+
+
+def portfolio_adapt_snapshot() -> dict:
+    """The adaptation evidence + the order currently in force
+    (serve's /healthz portfolio section)."""
+    with _ADAPT_LOCK:
+        wins = list(_ADAPT_WINS)
+        solves = _ADAPT_SOLVES[0]
+    enabled = portfolio_adapt_enabled()
+    order = _adapted_order(wins) if (
+        enabled and solves >= ADAPT_MIN_SOLVES
+    ) else list(range(len(PORTFOLIO_TABLE)))
+    return {
+        "enabled": enabled,
+        "solves": solves,
+        "min_solves": ADAPT_MIN_SOLVES,
+        "wins": wins,
+        "order": order,
+        "adapted": order != list(range(len(PORTFOLIO_TABLE))),
+    }
+
+
+def _adapted_order(wins: list[int]) -> list[int]:
+    """Lane 0 stays the default config (the portfolio's can-never-lose
+    anchor); the rest sort by win count descending, original order
+    breaking ties — never-winners sink to the tail and fall out of any
+    width below the table length."""
+    tail = sorted(range(1, len(PORTFOLIO_TABLE)),
+                  key=lambda i: (-wins[i], i))
+    return [0] + tail
+
+
 def portfolio_configs(width: int) -> list[LaneConfig]:
     """The first ``width`` portfolio lane configs (cycling past the
-    table, which no default reaches). Lane 0 is the default config."""
+    table, which no default reaches). Lane 0 is the default config.
+    With ``KAO_PORTFOLIO_ADAPT`` set and enough evidence banked, the
+    table order adapts (winners first, never-winners demoted)."""
     w = max(1, int(width))
-    return [PORTFOLIO_TABLE[i % len(PORTFOLIO_TABLE)] for i in range(w)]
+    table = PORTFOLIO_TABLE
+    if portfolio_adapt_enabled():
+        with _ADAPT_LOCK:
+            wins = list(_ADAPT_WINS)
+            solves = _ADAPT_SOLVES[0]
+        if solves >= ADAPT_MIN_SOLVES:
+            table = tuple(PORTFOLIO_TABLE[i]
+                          for i in _adapted_order(wins))
+    return [table[i % len(table)] for i in range(w)]
 
 
 def band_pen(c, lo, hi):

@@ -2194,6 +2194,15 @@ def _solve_tpu_inner(
             _sp.set(feasible=feasible, violations=sum(viol.values()),
                     moves=moves_final, proved_optimal=proved_optimal)
 
+    if port_lanes:
+        # adaptive-portfolio evidence (docs/PORTFOLIO.md): which config
+        # actually produced the winning plan — the stream the
+        # KAO_PORTFOLIO_ADAPT table reordering reads (pinned static
+        # table when the gate is off)
+        arrays.note_portfolio_result(
+            port_cfgs[winner_lane] if winner_lane is not None else None
+        )
+
     return SolveResult(
         a=best_a,
         solver="tpu",

@@ -91,7 +91,8 @@ class StormShed(Exception):
 
 class _Cluster:
     __slots__ = ("lock", "state", "plan", "plan_epoch", "plan_report",
-                 "solving", "active_budget", "pending_events")
+                 "pre_plan", "rollout_hold", "solving",
+                 "active_budget", "pending_events")
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -99,6 +100,19 @@ class _Cluster:
         self.plan: dict | None = None
         self.plan_epoch: int | None = None
         self.plan_report: dict | None = None
+        # the assignment as it stood immediately BEFORE the last plan
+        # merge: committing a plan ASSUMES the operator applies it, and
+        # a rollout `start` revisits that assumption — it rewinds the
+        # ground truth here and executes the plan wave by wave
+        # (docs/ROLLOUT.md)
+        self.pre_plan: dict | None = None
+        # True while a rollout owns this cluster's ground truth: set
+        # by begin_execution, cleared by end_execution / re-bootstrap,
+        # restored from the durable rollout record after a restart.
+        # Read UNDER c.lock at commit time — the hold decision and the
+        # commit are one atomic step, so a rollout starting mid-solve
+        # can never lose a merge/hold race
+        self.rollout_hold = False
         self.solving = False
         self.active_budget: Budget | None = None
         self.pending_events = 0
@@ -137,6 +151,20 @@ class WatchRegistry:
         self.window_s = max(float(window_s), 0.0)
         self.max_backlog = max(int(max_backlog), 1)
         self.solve_budget_s = solve_budget_s
+        # streaming plan rollout hook (docs/ROLLOUT.md), registered by
+        # rollout.exec.RolloutManager. While a cluster's rollout holds
+        # the ground truth (``_Cluster.rollout_hold``, maintained via
+        # begin_execution/end_execution and read atomically with the
+        # commit), a delta solve's commit persists the PLAN but does
+        # NOT fold it into the assignment (the cluster is mid-move;
+        # truth advances wave by wave via :meth:`commit_assignment`),
+        # and the committed plan is offered to ``replan_fn`` so the
+        # remaining waves re-pack against the partially-moved state.
+        # Lock ordering: the hook is only ever called while this
+        # registry does NOT hold the cluster lock — the rollout side
+        # takes its own lock first, then ours (strictly rollout ->
+        # cluster, never the reverse).
+        self.replan_fn = None
         self._lock = threading.Lock()
         self._clusters: dict[str, _Cluster] = {}
         self._counters = {
@@ -181,11 +209,27 @@ class WatchRegistry:
                     c.plan = rec.plan
                     c.plan_epoch = rec.plan_epoch
                     c.plan_report = rec.plan_report
+                    c.pre_plan = rec.pre_plan
+                    # a restart mid-rollout must keep holding the
+                    # ground truth (docs/ROLLOUT.md): restore the hold
+                    # from the durable rollout record's status — but
+                    # ONLY for the current generation (a record that
+                    # predates a re-bootstrap is a dead world and must
+                    # not freeze plan merges forever)
+                    ro = self.store.load_rollout(cluster_id)
+                    c.rollout_hold = bool(
+                        ro is not None
+                        and ro.get("status") not in ("done",
+                                                     "rolled_back")
+                        and int(ro.get("generation", 0))
+                        == rec.state.generation
+                    )
         return c
 
     def _persist(self, state: ClusterState, plan: dict | None,
                  plan_epoch: int | None,
-                 plan_report: dict | None) -> None:
+                 plan_report: dict | None,
+                 pre_plan: dict | None = None) -> None:
         """Durably save one record. Caller holds ``c.lock`` and commits
         the same values to the in-memory cluster ONLY after this
         returns: a save that raises (disk full, EIO) must leave memory
@@ -195,7 +239,7 @@ class WatchRegistry:
         if self.store is not None and state is not None:
             self.store.save(StoreRecord(
                 state=state, plan=plan, plan_epoch=plan_epoch,
-                plan_report=plan_report,
+                plan_report=plan_report, pre_plan=pre_plan,
             ))
 
     # -- read surface ---------------------------------------------------
@@ -223,12 +267,129 @@ class WatchRegistry:
                     c.state.topology.racks() if c.state.topology else []
                 ),
                 "partitions": len(c.state.assignment.partitions),
+                # the current GROUND-TRUTH assignment: equals the last
+                # plan between rollouts, but mid-rollout it is the
+                # partially-moved cluster the waves have built so far
+                "assignment": c.state.assignment.to_dict(),
                 "rf": c.state.rf,
                 "plan_epoch": c.plan_epoch,
                 "plan": c.plan,
                 "plan_report": c.plan_report,
                 "solving": c.solving,
                 "pending_events": c.pending_events,
+            }
+
+    def topology_of(self, cluster_id: str):
+        """The cluster's current :class:`~..models.cluster.Topology`
+        (None when unracked/unknown) — the rollout packer's rack-cap
+        input."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            return c.state.topology if c.state is not None else None
+
+    def commit_assignment(self, cluster_id: str, targets) -> dict:
+        """Fold externally-executed replica movements into the
+        cluster's ground-truth assignment — the rollout executor's wave
+        apply/rollback path (docs/ROLLOUT.md). ``targets`` is an
+        iterable of ``(topic, partition, replicas)``; partitions not
+        named are untouched, and naming a partition the cluster does
+        not know is an :class:`EventError` (a wave can never invent
+        state). Persist-before-commit like every other mutation; the
+        cluster EVENT epoch does not move — waves are fenced by the
+        rollout's own epoch sequence. Returns the new assignment
+        dict."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            if c.state is None:
+                raise EventError(f"unknown cluster {cluster_id!r}")
+            by = {(t, int(p)): [int(b) for b in r]
+                  for t, p, r in targets}
+            known = {(p.topic, p.partition)
+                     for p in c.state.assignment.partitions}
+            unknown = sorted(set(by) - known)
+            if unknown:
+                raise EventError(
+                    f"wave names unknown partition(s) {unknown[:5]}"
+                )
+            parts = [
+                replace(p, replicas=list(
+                    by.get((p.topic, p.partition), p.replicas)
+                ))
+                for p in c.state.assignment.partitions
+            ]
+            new_assignment = Assignment(
+                partitions=parts, version=c.state.assignment.version,
+            )
+            new_state = replace(c.state, assignment=new_assignment)
+            # the optimistic-merge assumption is dead once a wave has
+            # physically moved the truth: drop the rewind point so a
+            # LATER rollout can never rewind past executed work
+            self._persist(new_state, c.plan, c.plan_epoch,
+                          c.plan_report, None)
+            c.state = new_state
+            c.pre_plan = None
+            return new_assignment.to_dict()
+
+    def begin_execution(self, cluster_id: str) -> dict:
+        """Rollout ``start`` (docs/ROLLOUT.md): the committed plan is a
+        DESTINATION, not an applied fact. Rewind the ground-truth
+        assignment to the pre-plan truth captured at the last merge —
+        CONSUMING the rewind point, so a later start after this rollout
+        completes can never rewind real executed state to a stale base
+        — and raise the hold: until :meth:`end_execution`, delta-solve
+        commits persist their plan without merging it. Returns the
+        base assignment dict the rollout executes from."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            if c.state is None:
+                raise EventError(f"unknown cluster {cluster_id!r}")
+            if (c.pre_plan is not None and c.plan is not None
+                    and c.state.assignment.to_dict() == c.plan):
+                base = Assignment.from_dict(c.pre_plan)
+                new_state = replace(c.state, assignment=base)
+                self._persist(new_state, c.plan, c.plan_epoch,
+                              c.plan_report, None)
+                c.state = new_state
+            elif c.pre_plan is not None:
+                # stale rewind point (events moved the world since the
+                # merge): consume it DURABLY, or a crash could
+                # resurrect it for a later start
+                self._persist(c.state, c.plan, c.plan_epoch,
+                              c.plan_report, None)
+            c.pre_plan = None
+            c.rollout_hold = True
+            return c.state.assignment.to_dict()
+
+    def end_execution(self, cluster_id: str) -> None:
+        """The rollout reached a terminal state (done / rolled_back):
+        release the ground-truth hold — future plan commits merge
+        normally again."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            c.rollout_hold = False
+
+    def assignment_of(self, cluster_id: str) -> dict | None:
+        """The current ground-truth assignment alone — the rollout
+        replan path's accessor (``get_cluster`` serializes the whole
+        view; this serializes one assignment)."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            return (c.state.assignment.to_dict()
+                    if c.state is not None else None)
+
+    def plan_info(self, cluster_id: str) -> dict | None:
+        """The certified plan + its epoch + the cluster generation,
+        WITHOUT serializing the assignment (the plan is stored as a
+        dict already, so this is reference-cheap) — the rollout
+        ``start``/fence path's accessor."""
+        c = self._cluster(cluster_id)
+        with c.lock:
+            if c.state is None:
+                return None
+            return {
+                "plan": c.plan,
+                "plan_epoch": c.plan_epoch,
+                "generation": c.state.generation,
             }
 
     # -- the delta path -------------------------------------------------
@@ -267,11 +428,20 @@ class WatchRegistry:
                     retry_after_s=max(self.window_s * 2.0, 0.25),
                 )
             new_state = apply_event(c.state, cluster_id, ev)
+            # a (re-)bootstrap re-declares the ground truth: the old
+            # pre-plan rewind point describes a dead world, and any
+            # in-flight rollout's hold is released (its record is
+            # generation-fenced on the rollout side)
+            pre = None if ev.get("type") == "bootstrap" else c.pre_plan
             # persist BEFORE the in-memory commit: if the save raises,
             # the epoch has not advanced and the client's retry of the
             # same event is admitted, not fenced
-            self._persist(new_state, c.plan, c.plan_epoch, c.plan_report)
+            self._persist(new_state, c.plan, c.plan_epoch,
+                          c.plan_report, pre)
             c.state = new_state
+            c.pre_plan = pre
+            if ev.get("type") == "bootstrap":
+                c.rollout_hold = False
             self._count(events_total=1)
             if c.solving:
                 # coalesce: ack now, cancel the superseded in-flight
@@ -347,6 +517,8 @@ class WatchRegistry:
         warm = bool(report.get("solver_warm_started")
                     or report.get("warm_started"))
         self._count(solves_total=1, warm_solves_total=int(warm))
+        committed = False
+        hold = False
         with c.lock:
             # the plan is the cluster's assignment going forward: the
             # next event diffs against it, so per-event move counts
@@ -361,19 +533,35 @@ class WatchRegistry:
             # drain re-solve plans against the new reality instead.
             if c.state.generation == target.generation:
                 summary = _report_summary(report)
-                new_state = replace(
-                    c.state,
-                    assignment=_merge_plan(
+                # mid-rollout the assignment is NOT the plan: the
+                # waves advance it (commit_assignment); the plan is
+                # the destination the remaining waves chase. On a
+                # normal merge the pre-merge assignment is kept as the
+                # rewind point a later rollout `start` executes from.
+                # The hold is read HERE, under the same lock as the
+                # commit — a rollout starting mid-solve either lands
+                # its begin_execution before this commit (we hold) or
+                # after it (it rewinds the merged truth); no ordering
+                # loses the race.
+                hold = c.rollout_hold
+                if hold:
+                    merged = c.state.assignment
+                    pre = c.pre_plan
+                else:
+                    merged = _merge_plan(
                         c.state.assignment,
                         Assignment.from_dict(plan_dict)
-                    ),
-                )
+                    )
+                    pre = c.state.assignment.to_dict()
+                new_state = replace(c.state, assignment=merged)
                 self._persist(new_state, plan_dict, target.epoch,
-                              summary)
+                              summary, pre)
                 c.plan = plan_dict
                 c.plan_epoch = target.epoch
                 c.plan_report = summary
                 c.state = new_state
+                c.pre_plan = pre
+                committed = True
             superseded = budget.cancelled
             c.active_budget = None
             retained = c.pending_events > 0
@@ -384,6 +572,13 @@ class WatchRegistry:
                   superseded=superseded,
                   moves=report.get("replica_moves"),
                   feasible=report.get("feasible"))
+        if committed and hold and self.replan_fn is not None:
+            # mid-rollout re-plan (docs/ROLLOUT.md): the new plan was
+            # solved against the partially-moved truth; hand it to the
+            # rollout so the REMAINING waves chase it. Outside c.lock
+            # (the hook takes the rollout lock, then may re-enter ours)
+            # and exception-proofed on the hook's side.
+            self.replan_fn(cluster_id, plan_dict, target.epoch)
         return {
             "cluster_id": cluster_id,
             "status": "planned",

@@ -36,19 +36,24 @@ _RECORD_VERSION = 1
 
 
 class StoreRecord:
-    """One cluster's durable record: ``state`` (latest epoch), and the
+    """One cluster's durable record: ``state`` (latest epoch), the
     last certified ``plan``/``plan_epoch``/``plan_report`` (None until
-    the first solve lands)."""
+    the first solve lands), and ``pre_plan`` — the ground-truth
+    assignment as it stood immediately BEFORE the last plan merge, the
+    rewind point a rollout ``start`` executes from (docs/ROLLOUT.md)."""
 
-    __slots__ = ("state", "plan", "plan_epoch", "plan_report")
+    __slots__ = ("state", "plan", "plan_epoch", "plan_report",
+                 "pre_plan")
 
     def __init__(self, state: ClusterState, plan: dict | None = None,
                  plan_epoch: int | None = None,
-                 plan_report: dict | None = None):
+                 plan_report: dict | None = None,
+                 pre_plan: dict | None = None):
         self.state = state
         self.plan = plan
         self.plan_epoch = plan_epoch
         self.plan_report = plan_report
+        self.pre_plan = pre_plan
 
 
 def _payload(rec: StoreRecord) -> dict:
@@ -58,6 +63,7 @@ def _payload(rec: StoreRecord) -> dict:
         "plan": rec.plan,
         "plan_epoch": rec.plan_epoch,
         "plan_report": rec.plan_report,
+        "pre_plan": rec.pre_plan,
     }
 
 
@@ -118,6 +124,7 @@ class PlanStore:
                 plan=payload.get("plan"),
                 plan_epoch=payload.get("plan_epoch"),
                 plan_report=payload.get("plan_report"),
+                pre_plan=payload.get("pre_plan"),
             )
         except (OSError, ValueError, KeyError) as e:
             _olog.error("watch_store_unreadable", cluster=cluster_id,
@@ -129,3 +136,56 @@ class PlanStore:
             p.stem for p in self.root.glob("*.json")
             if valid_cluster_id(p.stem)
         )
+
+    # -- rollout records (docs/ROLLOUT.md) ------------------------------
+    # Same write discipline, separate namespace: rollout progress lives
+    # under ``<root>/rollout/<cluster>.json`` (a subdirectory, not a
+    # suffix — cluster ids may contain dots, so ``foo.rollout.json``
+    # would collide with a legal cluster named ``foo.rollout`` in
+    # ``list_clusters``). The payload is the executor's serialized
+    # :class:`~..rollout.state.RolloutRecord`; this layer only verifies
+    # integrity, never interprets it.
+
+    def _rollout_path(self, cluster_id: str) -> Path:
+        if not valid_cluster_id(cluster_id):
+            raise ValueError(f"bad cluster id {cluster_id!r}")
+        return self.root / "rollout" / f"{cluster_id}.json"
+
+    def save_rollout(self, cluster_id: str, record: dict) -> None:
+        """Atomically persist one rollout record (write tmp, fsync,
+        rename — the :meth:`save` discipline verbatim)."""
+        path = self._rollout_path(cluster_id)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        payload = {"version": _RECORD_VERSION, "rollout": record}
+        payload["fingerprint"] = _fingerprint(payload)
+        tmp = path.with_suffix(".json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load_rollout(self, cluster_id: str) -> dict | None:
+        """The verified rollout record payload, or None (absent OR
+        corrupt — logged and ignored, never trusted)."""
+        path = self._rollout_path(cluster_id)
+        if not path.exists():
+            return None
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            fp = payload.pop("fingerprint", None)
+            if fp != _fingerprint(payload):
+                _olog.error("rollout_store_corrupt", cluster=cluster_id,
+                            path=str(path))
+                return None
+            if payload.get("version") != _RECORD_VERSION:
+                _olog.warn("rollout_store_version_skew",
+                           cluster=cluster_id,
+                           version=payload.get("version"))
+                return None
+            return payload["rollout"]
+        except (OSError, ValueError, KeyError) as e:
+            _olog.error("rollout_store_unreadable", cluster=cluster_id,
+                        error=repr(e)[:200])
+            return None

@@ -171,6 +171,18 @@ def test_solve_seconds_histogram_and_exemplars_render():
     assert "kao_slo_events_total" in names
 
 
+def test_rollout_families_predeclared_at_zero():
+    """ISSUE 12 satellite: every kao_rollout_* family renders (at
+    zero) before the first rollout ever runs, with HELP/TYPE pairs —
+    dashboards can alert on rate() from day one."""
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    for k in srv._ROLLOUT_COUNTER_NAMES:
+        assert f"kao_rollout_{k}" in names, k
+    assert "kao_rollout_active" in names  # the gauge rides along
+
+
 def test_metrics_http_content_type():
     """ISSUE 9 satellite: /metrics serves the Prometheus text
     exposition content type (version 0.0.4) over real HTTP."""

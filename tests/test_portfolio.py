@@ -135,6 +135,42 @@ def test_portfolio_configs_table():
     assert rt == dataclasses.asdict(cfgs[3])
 
 
+def test_adaptive_table_demotes_never_winners(monkeypatch):
+    """ISSUE 12 satellite (the ROADMAP item 3 follow-on): with
+    KAO_PORTFOLIO_ADAPT set and enough evidence, never-winning configs
+    sink to the tail (and out of sub-table widths); with the gate off
+    — the default — the table is PINNED to the static order
+    regardless of banked evidence."""
+    arrays.reset_portfolio_adapt()
+    try:
+        monkeypatch.delenv("KAO_PORTFOLIO_ADAPT", raising=False)
+        for _ in range(arrays.ADAPT_MIN_SOLVES + 4):
+            arrays.note_portfolio_result(arrays.PORTFOLIO_TABLE[5])
+        # pinned-table default: evidence banked, order unchanged
+        assert arrays.portfolio_configs(8) == list(
+            arrays.PORTFOLIO_TABLE)
+        snap = arrays.portfolio_adapt_snapshot()
+        assert not snap["enabled"] and not snap["adapted"]
+        assert snap["wins"][5] == arrays.ADAPT_MIN_SOLVES + 4
+        # gate on: winners first, lane 0 still the default anchor
+        monkeypatch.setenv("KAO_PORTFOLIO_ADAPT", "1")
+        cfgs = arrays.portfolio_configs(8)
+        assert cfgs[0] == arrays.DEFAULT_CONFIG
+        assert cfgs[1] == arrays.PORTFOLIO_TABLE[5]
+        # a width-2 portfolio now races the actual winner, not slot 1
+        assert arrays.portfolio_configs(2)[1] \
+            == arrays.PORTFOLIO_TABLE[5]
+        snap = arrays.portfolio_adapt_snapshot()
+        assert snap["adapted"] and snap["order"][1] == 5
+        # below the evidence floor nothing reorders, even gated on
+        arrays.reset_portfolio_adapt()
+        arrays.note_portfolio_result(arrays.PORTFOLIO_TABLE[3])
+        assert arrays.portfolio_configs(8) == list(
+            arrays.PORTFOLIO_TABLE)
+    finally:
+        arrays.reset_portfolio_adapt()
+
+
 # -------------------------------------------------- engine + early exit
 
 

@@ -1,0 +1,938 @@
+"""Streaming plan rollout (ISSUE 12, docs/ROLLOUT.md): wave packing
+under per-wave transfer caps, the epoch-fenced rollout state machine,
+canary/rollback semantics, mid-rollout re-plans against the
+partially-moved ground truth, the serve endpoints over real HTTP, the
+durable record, and the ``kao_rollout_*`` metric families — including
+the acceptance proofs: every wave's caps asserted straight off the
+move graph, rollback restoring the pre-rollout assignment bit-exactly,
+and every transition visible simultaneously in the plan store, flight
+records, trace spans, and metrics."""
+
+import json
+import os
+import signal
+import threading
+from pathlib import Path
+
+import pytest
+
+from kafka_assignment_optimizer_tpu import serve as srv
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    Topology,
+)
+from kafka_assignment_optimizer_tpu.obs import flight as oflight
+from kafka_assignment_optimizer_tpu.obs import trace as otrace
+from kafka_assignment_optimizer_tpu.resilience.budget import Budget
+from kafka_assignment_optimizer_tpu.rollout import exec as rexec
+from kafka_assignment_optimizer_tpu.rollout import state as rstate
+from kafka_assignment_optimizer_tpu.rollout import waves as rwaves
+from kafka_assignment_optimizer_tpu.watch import manager as wman
+from kafka_assignment_optimizer_tpu.watch import store as wstore
+
+GOLDEN = Path(__file__).parent / "golden" / "waves"
+
+
+def _assign(P=8, B=4, rf=2, off=0):
+    return {
+        "version": 1,
+        "partitions": [
+            {"topic": "t", "partition": p,
+             "replicas": [(p + i + off) % B for i in range(rf)]}
+            for p in range(P)
+        ],
+    }
+
+
+def _bootstrap(epoch=1, B=4, P=8, **extra):
+    return {
+        "type": "bootstrap", "epoch": epoch,
+        "assignment": _assign(P=P, B=B),
+        "brokers": list(range(B)), "topology": "even-odd", **extra,
+    }
+
+
+def _stub_solve_fn(state, prev_plan, budget):
+    """Deterministic rebalancer: round-robin every partition over the
+    eligible brokers — real moves whenever eligibility changes."""
+    elig = sorted(state.brokers)
+    parts = []
+    for p in state.assignment.partitions:
+        rf = len(p.replicas) or 2
+        reps = [elig[(p.partition + i) % len(elig)] for i in range(rf)]
+        parts.append({"topic": p.topic, "partition": p.partition,
+                      "replicas": reps})
+    return ({"version": 1, "partitions": parts},
+            {"feasible": True, "replica_moves": 1})
+
+
+def _registry(store=None, caps=(2, 8)):
+    reg = wman.WatchRegistry(_stub_solve_fn, store, window_s=0.0)
+    mgr = rexec.RolloutManager(reg, store, broker_cap=caps[0],
+                               rack_cap=caps[1])
+    return reg, mgr
+
+
+def _wave_peaks(wave_moves, rack_of):
+    """Per-wave peak broker/rack loads recomputed INDEPENDENTLY from
+    the move graph (adds + source), never read back from the packer's
+    own accounting."""
+    bl, rl = {}, {}
+    for m in wave_moves:
+        adds = m.adds if hasattr(m, "adds") else m["adds"]
+        source = m.source if hasattr(m, "source") else m["source"]
+        for b in adds:
+            bl[b] = bl.get(b, 0) + 1
+            r = rack_of(b)
+            rl[r] = rl.get(r, 0) + 1
+            if source is not None:
+                bl[source] = bl.get(source, 0) + 1
+    return (max(bl.values(), default=0), max(rl.values(), default=0))
+
+
+# --------------------------------------------------------------------------
+# waves: the transfer model and both packers
+# --------------------------------------------------------------------------
+
+
+def test_moves_of_transfer_model():
+    cur = Assignment.from_dict(_assign())
+    tgt = Assignment.from_dict(_assign(off=1))
+    moves = rwaves.moves_of(cur, tgt)
+    assert len(moves) == 8
+    m0 = moves[0]
+    assert m0.old == (0, 1) and m0.new == (1, 2)
+    assert m0.adds == (2,)          # only genuinely new replicas copy
+    assert m0.source == 0           # the current leader streams it
+    assert m0.leader_changed        # 0 -> 1
+    # initial placement (empty current list): inbound only, no source
+    tgt2 = Assignment.from_dict(_assign())
+    cur2 = Assignment.from_dict(_assign())
+    cur2.partitions[0].replicas = []
+    m = rwaves.moves_of(cur2, tgt2)[0]
+    assert m.source is None and m.adds == (0, 1)
+    assert not m.leader_changed
+
+
+def test_pack_waves_caps_coverage_and_leader_order():
+    cur = Assignment.from_dict(_assign(P=12))
+    tgt = Assignment.from_dict(_assign(P=12, off=1))
+    topo = Topology.even_odd(range(4))
+    caps = rwaves.WaveCaps(broker=2, rack=4)
+    plan = rwaves.pack_waves(cur, tgt, topo, caps=caps)
+    assert plan.makespan >= 2
+    # every move appears exactly once across the waves
+    seen = [(m.topic, m.partition) for w in plan.waves
+            for m in w.moves]
+    assert sorted(seen) == sorted(
+        (m.topic, m.partition) for m in rwaves.moves_of(cur, tgt))
+    # the cap contract, asserted off the move graph per wave
+    for w in plan.waves:
+        pb, pr = _wave_peaks(w.moves, topo.rack)
+        assert pb <= plan.caps.broker and pr <= plan.caps.rack
+    assert rwaves.verify_caps(plan)
+    # leader-changing moves come LAST within each wave
+    for w in plan.waves:
+        flags = [m.leader_changed for m in w.ordered_moves()]
+        assert flags == sorted(flags)
+    # determinism: same inputs, same packing
+    again = rwaves.pack_waves(cur, tgt, topo, caps=caps)
+    assert again.to_dict() == plan.to_dict()
+
+
+def test_caps_below_single_move_floor_are_raised():
+    # partition 0 replaces both followers: the source (leader 0)
+    # streams 2 copies, so its own broker load is 2 — above a cap of 1,
+    # and a single partition's copy can never split across waves
+    cur = Assignment.from_dict(_assign(P=2, B=6, rf=3))
+    cur.partitions[0].replicas = [0, 1, 2]
+    tgt = Assignment.from_dict(_assign(P=2, B=6, rf=3))
+    tgt.partitions[0].replicas = [0, 4, 5]
+    plan = rwaves.pack_waves(
+        cur, tgt, None, caps=rwaves.WaveCaps(broker=1, rack=1))
+    assert plan.caps.raised
+    assert plan.caps.broker >= 2
+    assert rwaves.verify_caps(plan)
+
+
+def test_scored_packer_no_worse_than_greedy_and_budget_safe():
+    cur = Assignment.from_dict(_assign(P=24, B=6, rf=2))
+    tgt = Assignment.from_dict(_assign(P=24, B=6, rf=2, off=2))
+    topo = Topology.even_odd(range(6))
+    caps = rwaves.WaveCaps(broker=2, rack=4)
+    greedy = rwaves.pack_waves(cur, tgt, topo, caps=caps)
+    scored = rwaves.pack_waves(cur, tgt, topo, caps=caps,
+                               packer="scored", seed=3)
+    assert scored.score <= greedy.score
+    assert rwaves.verify_caps(scored)
+    # an expired budget stops the race but lane 0 always completes
+    b = Budget(None)
+    b.cancel()
+    under = rwaves.pack_waves(cur, tgt, topo, caps=caps,
+                              packer="scored", budget=b)
+    assert under.makespan >= 1 and rwaves.verify_caps(under)
+    with pytest.raises(ValueError):
+        rwaves.pack_waves(cur, tgt, topo, packer="nope")
+
+
+def test_wave_json_is_upstream_schema_with_leader_moves_last():
+    cur = Assignment.from_dict(_assign())
+    tgt = Assignment.from_dict(_assign(off=1))
+    plan = rwaves.pack_waves(cur, tgt, None,
+                             caps=rwaves.WaveCaps(broker=64, rack=256))
+    doc = rexec.wave_json(plan.waves[0])
+    assert set(doc) == {"version", "partitions"}
+    assert doc["version"] == 1
+    for p in doc["partitions"]:
+        assert set(p) == {"topic", "partition", "replicas"}
+        assert all(isinstance(b, int) for b in p["replicas"])
+    # the dialect round-trips through the model's own parser
+    Assignment.from_dict(doc)
+
+
+# --------------------------------------------------------------------------
+# CLI --emit-waves: per-wave files, byte-golden
+# --------------------------------------------------------------------------
+
+
+def test_emit_waves_golden_bytes(tmp_path):
+    """The satellite pin: wave files are byte-compatible with the
+    upstream reassignment schema — goldened on a fixed (current, plan)
+    pair so solver nondeterminism can never flake the bytes."""
+    cur = Assignment.from_dict(_assign(P=4, B=4, rf=2))
+    tgt = Assignment.from_dict(_assign(P=4, B=4, rf=2, off=1))
+    plan = rwaves.pack_waves(cur, tgt, Topology.even_odd(range(4)),
+                             caps=rwaves.WaveCaps(broker=1, rack=4))
+    got = {
+        f"wave-{w.index:03d}.json":
+            json.dumps(rexec.wave_json(w), indent=2) + "\n"
+        for w in plan.waves
+    }
+    golden_files = sorted(p.name for p in GOLDEN.glob("wave-*.json"))
+    assert golden_files == sorted(got), (
+        "wave schedule changed; regenerate tests/golden/waves/ and "
+        "review the diff deliberately"
+    )
+    for name in golden_files:
+        assert (GOLDEN / name).read_text() == got[name], name
+
+
+def test_emit_waves_cli(tmp_path):
+    """The CLI path end to end: --emit-waves writes files that parse
+    as reassignment JSON and byte-match the library packing of the
+    CLI's own input/output pair."""
+    import subprocess
+    import sys
+
+    cur = _assign(P=8, B=4, rf=2)
+    inp = tmp_path / "cur.json"
+    inp.write_text(json.dumps(cur))
+    outp = tmp_path / "plan.json"
+    waves_dir = tmp_path / "waves"
+    r = subprocess.run(
+        [sys.executable, "-m", "kafka_assignment_optimizer_tpu",
+         "-i", str(inp), "-o", str(outp), "--broker-list", "0-2",
+         "--topology", "even-odd", "--solver", "milp",
+         "--emit-waves", str(waves_dir), "--wave-broker-cap", "1",
+         "--report"],
+        cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rep = json.loads(r.stderr[r.stderr.index("{"):])
+    assert rep["waves"]["makespan"] >= 1
+    files = sorted(waves_dir.glob("wave-*.json"))
+    assert len(files) == rep["waves"]["makespan"]
+    # byte-compat: the files equal the library packing of the same pair
+    plan = rwaves.pack_waves(
+        Assignment.from_dict(cur),
+        Assignment.from_json(outp.read_text()),
+        Topology.even_odd(range(4)),
+        caps=rwaves.WaveCaps(broker=1, rack=16),
+    )
+    for f, w in zip(files, plan.waves):
+        assert f.read_text() == \
+            json.dumps(rexec.wave_json(w), indent=2) + "\n"
+    # applying the waves in file order reproduces the plan exactly
+    state = {(p["topic"], p["partition"]): p["replicas"]
+             for p in cur["partitions"]}
+    for f in files:
+        for p in json.loads(f.read_text())["partitions"]:
+            state[(p["topic"], p["partition"])] = p["replicas"]
+    final = json.loads(outp.read_text())
+    assert state == {(p["topic"], p["partition"]): p["replicas"]
+                     for p in final["partitions"]}
+
+
+# --------------------------------------------------------------------------
+# state machine + fencing (store provably untouched)
+# --------------------------------------------------------------------------
+
+
+def test_state_machine_transitions_and_conflicts(tmp_path):
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    v = mgr.command("c", "start", {"epoch": 1})
+    assert v["status"] == "planned" and v["waves"] >= 2
+    # start over an active rollout is a conflict, not a new rollout
+    with pytest.raises(rstate.RolloutConflict):
+        mgr.command("c", "start", {"epoch": 2})
+    v = mgr.command("c", "advance", {"epoch": 2})
+    assert v["status"] == "canary" and v["current_wave"] is not None
+    # advancing past canary demands the operator's verdict
+    with pytest.raises(rstate.RolloutError):
+        mgr.command("c", "advance", {"epoch": 3})
+    v = mgr.command("c", "pause", {"epoch": 3})
+    assert v["status"] == "paused"
+    with pytest.raises(rstate.RolloutConflict):
+        mgr.command("c", "pause", {"epoch": 4})
+    v = mgr.command("c", "advance", {"epoch": 4})   # resume
+    assert v["status"] == "canary"
+    v = mgr.command("c", "advance", {"epoch": 5, "canary_ok": True})
+    assert v["status"] in ("advancing", "done")
+    assert v["applied"] == [0]
+    # commands need an epoch at all
+    with pytest.raises(rstate.RolloutError):
+        mgr.command("c", "advance", {})
+
+
+def test_canary_failure_rolls_back(tmp_path):
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    base = reg.get_cluster("c")["assignment"]
+    mgr.command("c", "start", {"epoch": 1})
+    base_post_rewind = reg.get_cluster("c")["assignment"]
+    mgr.command("c", "advance", {"epoch": 2})
+    v = mgr.command("c", "advance", {"epoch": 3, "canary_ok": False})
+    assert v["status"] == "rolled_back"
+    assert v["rollback_reason"] == "canary_fail"
+    assert mgr.snapshot()["canary_fail_total"] == 1
+    # the canary wave was never applied, so truth is the rewound base
+    assert reg.get_cluster("c")["assignment"] == base_post_rewind
+
+
+def test_stale_epoch_fenced_without_touching_store(tmp_path):
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 5})
+    mgr.command("c", "advance", {"epoch": 6})
+    path = tmp_path / "rollout" / "c.json"
+    before = path.read_bytes()
+    n_cmds = mgr.snapshot()["commands_total"]
+    with pytest.raises(rstate.RolloutFenced) as e:
+        mgr.command("c", "advance", {"epoch": 6, "canary_ok": True})
+    assert e.value.got == 6 and e.value.current == 6
+    # THE fencing proof: the fence counter moved, nothing else did,
+    # and the durable record is byte-identical
+    snap = mgr.snapshot()
+    assert snap["fenced_total"] == 1
+    assert snap["commands_total"] == n_cmds
+    assert path.read_bytes() == before
+    # the stream continues at the correct epoch
+    v = mgr.command("c", "advance", {"epoch": 7, "canary_ok": True})
+    assert v["applied"] == [0]
+
+
+def test_rollback_restores_pre_rollout_bit_exact(tmp_path):
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap(P=12))
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    v = mgr.command("c", "start", {"epoch": 1})
+    base = reg.get_cluster("c")["assignment"]  # post-rewind pre-rollout
+    assert v["waves"] >= 2
+    mgr.command("c", "advance", {"epoch": 2})
+    v = mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    # ground truth moved away from base...
+    assert reg.get_cluster("c")["assignment"] != base
+    v = mgr.command("c", "rollback", {"epoch": 4})
+    assert v["status"] == "rolled_back"
+    assert v["inverse_waves"]  # the inverse reassignments, newest first
+    # ...and rollback restored it BIT-EXACTLY
+    assert reg.get_cluster("c")["assignment"] == base
+    assert json.dumps(reg.get_cluster("c")["assignment"],
+                      sort_keys=True) == json.dumps(base, sort_keys=True)
+
+
+def test_second_start_after_done_does_not_rewind(tmp_path):
+    """Review fix: once waves have EXECUTED the plan, the pre-plan
+    rewind point is consumed — a later start must base on the real
+    ground truth (zero waves, immediately done), never rewind executed
+    state to the stale pre-rollout base."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    mgr.command("c", "advance", {"epoch": 2})
+    v = mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    ep = 4
+    while v["status"] == "advancing":
+        v = mgr.command("c", "advance", {"epoch": ep})
+        ep += 1
+    assert v["status"] == "done"
+    executed = reg.get_cluster("c")["assignment"]
+    assert executed == reg.get_cluster("c")["plan"]
+    v2 = mgr.command("c", "start", {"epoch": ep})
+    assert v2["status"] == "done" and v2["waves"] == 0
+    # the ground truth was NOT rewound to the pre-rollout base
+    assert reg.get_cluster("c")["assignment"] == executed
+    # and a post-rollout delta solve merges its plan normally again
+    reg.handle_event("c", {"type": "broker_add", "epoch": 3,
+                           "brokers": [3]})
+    info = reg.get_cluster("c")
+    assert info["assignment"] == info["plan"]
+
+
+def test_rebootstrap_voids_active_rollout(tmp_path):
+    """Review fix: a re-bootstrap re-declares the world — the active
+    rollout's record is generation-fenced (commands refuse, a fresh
+    start is admitted) and the registry's ground-truth hold is
+    released."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    mgr.command("c", "advance", {"epoch": 2})
+    reg.handle_event("c", _bootstrap(epoch=3))  # generation bump
+    with pytest.raises(rstate.RolloutConflict) as e:
+        mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    assert "re-bootstrap" in str(e.value)
+    # the hold is released: a delta solve merges normally again
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 4,
+                           "brokers": [3]})
+    info = reg.get_cluster("c")
+    assert info["assignment"] == info["plan"]
+    # and a fresh start (new generation) is admitted
+    v = mgr.command("c", "start", {"epoch": 3})
+    assert v["status"] in ("planned", "done")
+
+
+def test_restart_ignores_dead_generation_hold(tmp_path):
+    """Review fix: a restart must NOT resurrect the ground-truth hold
+    from a rollout record that predates a re-bootstrap — the cluster
+    would silently stop merging plans forever."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    mgr.command("c", "advance", {"epoch": 2})     # active, gen 0
+    reg.handle_event("c", _bootstrap(epoch=3))    # gen 1
+    # restart: fresh registry over the same store
+    reg2, mgr2 = _registry(store)
+    reg2.handle_event("c", {"type": "broker_drain", "epoch": 4,
+                            "brokers": [3]})
+    info = reg2.get_cluster("c")
+    # the plan merged normally: the stale record's hold did not stick
+    assert info["assignment"] == info["plan"]
+
+
+def test_start_failure_after_rewind_releases_hold(tmp_path,
+                                                  monkeypatch):
+    """Review fix: a start that fails AFTER begin_execution (failed
+    save, bad packer) must release the hold — no record exists to
+    drive the cluster, so plan merges must keep working."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+
+    def boom(cluster_id, record):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(store, "save_rollout", boom)
+    with pytest.raises(OSError):
+        mgr.command("c", "start", {"epoch": 1})
+    monkeypatch.undo()
+    assert mgr.get("c") is None
+    # the hold was released: the next delta solve merges its plan
+    reg.handle_event("c", {"type": "broker_add", "epoch": 3,
+                           "brokers": [3]})
+    info = reg.get_cluster("c")
+    assert info["assignment"] == info["plan"]
+
+
+def test_failed_save_does_not_fence_the_retry(tmp_path, monkeypatch):
+    """Review fix: commands mutate a working copy, swapped in only
+    after the persist succeeds — a failed save leaves memory and disk
+    agreeing, so the client's retry of the SAME epoch is admitted,
+    not 409d on a command that was never durably recorded."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    mgr.command("c", "advance", {"epoch": 2})
+    real_save = store.save_rollout
+    calls = {"n": 0}
+
+    def flaky(cluster_id, record):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+        return real_save(cluster_id, record)
+
+    monkeypatch.setattr(store, "save_rollout", flaky)
+    with pytest.raises(OSError):
+        mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    # memory did not advance past disk: the SAME epoch retries clean
+    v = mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    assert v["applied"] == [0] and v["rollout_epoch"] == 3
+
+
+def test_rollback_unplaces_partitions_grown_mid_rollout(tmp_path):
+    """Review fix: a partition created by a mid-rollout growth event
+    and placed by a post-replan wave rolls back to the EMPTY replica
+    list growth declared — not to its rollout-assigned placement."""
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store, caps=(2, 8))
+    reg.handle_event("c", _bootstrap(P=8))
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    base = reg.get_cluster("c")["assignment"]
+    mgr.command("c", "advance", {"epoch": 2})
+    mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    # growth mid-rollout: two new partitions appear empty and the
+    # replanned remaining waves place them
+    reg.handle_event("c", {"type": "partition_growth", "epoch": 3,
+                           "topic": "t", "add": 2})
+    v = mgr.get("c")
+    assert v["replans"] == 1
+    # apply every remaining wave so the placements land
+    ep = 4
+    while v["status"] in ("canary", "advancing"):
+        p = {"epoch": ep}
+        if v["status"] == "canary":
+            p["canary_ok"] = True
+        v = mgr.command("c", "advance", p)
+        ep += 1
+        if len(v["applied"]) >= 2 and v["status"] == "advancing":
+            break
+    grown = {("t", 8), ("t", 9)}
+    truth = {(p["topic"], p["partition"]): p["replicas"]
+             for p in reg.get_cluster("c")["assignment"]["partitions"]}
+    placed = {k for k in grown if truth[k]}
+    v = mgr.command("c", "rollback", {"epoch": ep})
+    assert v["status"] == "rolled_back"
+    after = {(p["topic"], p["partition"]): p["replicas"]
+             for p in reg.get_cluster("c")["assignment"]["partitions"]}
+    # grown partitions are UN-placed (their pre-rollout truth)...
+    for k in placed:
+        assert after[k] == [], (k, after[k])
+    # ...and every base partition is bit-exactly back at base
+    base_by = {(p["topic"], p["partition"]): p["replicas"]
+               for p in base["partitions"]}
+    for k, reps in base_by.items():
+        assert after[k] == reps, k
+
+
+def test_record_survives_restart_same_wave_same_epoch(tmp_path):
+    store = wstore.PlanStore(tmp_path)
+    reg, mgr = _registry(store)
+    reg.handle_event("c", _bootstrap())
+    reg.handle_event("c", {"type": "broker_drain", "epoch": 2,
+                           "brokers": [3]})
+    mgr.command("c", "start", {"epoch": 1})
+    mgr.command("c", "advance", {"epoch": 2})
+    v = mgr.command("c", "advance", {"epoch": 3, "canary_ok": True})
+    # a fresh registry + manager over the same store (process restart)
+    reg2, mgr2 = _registry(store)
+    v2 = mgr2.get("c")
+    assert v2["status"] == v["status"]
+    assert v2["wave_index"] == v["wave_index"]
+    assert v2["rollout_epoch"] == 3
+    # the fence survives the restart too
+    with pytest.raises(rstate.RolloutFenced):
+        mgr2.command("c", "advance", {"epoch": 3})
+    # a corrupt rollout record is ignored, never trusted
+    path = tmp_path / "rollout" / "c.json"
+    path.write_text(path.read_text()[:-20] + "}")
+    reg3, mgr3 = _registry(store)
+    assert mgr3.get("c") is None
+
+
+# --------------------------------------------------------------------------
+# serve layer: the endpoints over real HTTP — the acceptance flow
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def rollout_env(tmp_path, monkeypatch):
+    monkeypatch.setitem(srv.WATCH, "dir", str(tmp_path / "watch"))
+    monkeypatch.setitem(srv.WATCH, "registry", None)
+    monkeypatch.setitem(srv.WATCH, "window_s", 0.0)
+    monkeypatch.setitem(srv.ROLLOUT, "manager", None)
+    monkeypatch.setitem(srv.ROLLOUT, "broker_cap", 1)
+    monkeypatch.setitem(srv.ROLLOUT, "rack_cap", 8)
+    server = srv.make_server(port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield (tmp_path, f"http://127.0.0.1:{server.server_address[1]}")
+    server.shutdown()
+    server.server_close()
+    srv.WATCH["registry"] = None
+    srv.ROLLOUT["manager"] = None
+
+
+def _http(method, url, payload=None, timeout=60):
+    import urllib.error
+    import urllib.request
+
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _counter(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    raise AssertionError(f"{name} not in /metrics")
+
+
+def test_http_e2e_certify_canary_waves_caps_and_surfaces(rollout_env):
+    """The acceptance flow over real HTTP: submit -> certify -> start
+    -> canary -> advance through >= 3 waves, every wave's transfer
+    caps asserted from the move graph against the live pre-wave ground
+    truth; all transitions visible simultaneously in the plan store,
+    flight records, trace spans, and kao_rollout_* metrics."""
+    tmp_path, url = rollout_env
+    st, _ = _http("POST", url + "/clusters/prod/events",
+                  _bootstrap(B=4, P=8))
+    assert st == 200
+    st, body = _http("POST", url + "/clusters/prod/events",
+                     {"type": "broker_drain", "epoch": 2,
+                      "brokers": [3]})
+    assert st == 200
+    assert body["report"]["feasible"]
+    assert body["report"]["proven_optimal"]  # certified plan
+    moves_planned = body["report"]["replica_moves"]
+    assert moves_planned >= 3
+
+    st, view = _http("POST", url + "/clusters/prod/rollout/start",
+                     {"epoch": 1})
+    assert st == 200 and view["status"] == "planned"
+    assert view["waves"] >= 3                     # >= 3 waves at cap 1
+    assert view["caps"] == {"broker": 1, "rack": 8, "raised": False}
+    topo = Topology.even_odd(range(4))
+
+    def advance(ep, **extra):
+        # the ground truth BEFORE the wave applies: sources derive
+        # from it, so cap math is checked against the real move graph
+        _, info = _http("GET", url + "/clusters/prod")
+        truth = {(p["topic"], p["partition"]): p["replicas"]
+                 for p in info["assignment"]["partitions"]}
+        _, v = _http("GET", url + "/clusters/prod/rollout")
+        wave = v["current_wave"]
+        if wave is not None:
+            bl, rl = {}, {}
+            for p in wave["partitions"]:
+                old = truth[(p["topic"], p["partition"])]
+                adds = [b for b in p["replicas"] if b not in set(old)]
+                src = old[0] if old else None
+                for b in adds:
+                    bl[b] = bl.get(b, 0) + 1
+                    r = topo.rack(b)
+                    rl[r] = rl.get(r, 0) + 1
+                    if src is not None:
+                        bl[src] = bl.get(src, 0) + 1
+            assert max(bl.values(), default=0) <= v["caps"]["broker"]
+            assert max(rl.values(), default=0) <= v["caps"]["rack"]
+        st, v = _http("POST", url + "/clusters/prod/rollout/advance",
+                      {"epoch": ep, **extra})
+        assert st == 200, v
+        return v
+
+    view = advance(2)                       # planned -> canary
+    assert view["status"] == "canary"
+    view = advance(3, canary_ok=True)       # canary verified, applied
+    ep = 4
+    while view["status"] == "advancing":
+        view = advance(ep)
+        ep += 1
+    assert view["status"] == "done"
+    assert len(view["applied"]) == view["waves"] >= 3
+    # the executed truth IS the certified plan
+    _, info = _http("GET", url + "/clusters/prod")
+    assert info["assignment"] == info["plan"]
+
+    # -- simultaneous visibility on all four surfaces ------------------
+    # 1) plan store: the durable rollout record, fingerprint-verified
+    rec = wstore.PlanStore(srv.WATCH["dir"]).load_rollout("prod")
+    assert rec is not None and rec["status"] == "done"
+    assert rec["applied"] == list(range(view["waves"]))
+    # 2) flight records: one kind="rollout" per transition, and
+    # 3) trace spans: each record's trace_id resolves in the ring
+    recs = [r for r in oflight.recent(kind="rollout")
+            if r.get("cluster") == "prod"]
+    assert len(recs) >= view["waves"] + 2   # start + canary + waves
+    assert {r["command"] for r in recs} >= {"start", "advance"}
+    tid = recs[-1]["trace_id"]
+    rep = otrace.RECENT.get(tid)
+    assert rep is not None and rep["name"] == "rollout"
+    # 4) metrics: the counter families moved together
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert _counter(text, "kao_rollout_started_total") >= 1
+    assert _counter(text, "kao_rollout_waves_applied_total") \
+        == view["waves"]
+    assert _counter(text, "kao_rollout_completed_total") >= 1
+    assert _counter(text, "kao_rollout_active") == 0
+    from tests.test_metrics_format import validate_prometheus
+
+    validate_prometheus(text)
+
+
+def test_http_mid_rollout_event_replans_remaining_waves(rollout_env):
+    """A broker_remove mid-rollout re-solves against the PARTIALLY-
+    MOVED ground truth (never clobbered by the new plan) and re-packs
+    the remaining waves toward it — warm-started through the same
+    watch machinery."""
+    tmp_path, url = rollout_env
+    _http("POST", url + "/clusters/prod/events", _bootstrap(B=5, P=10))
+    st, _ = _http("POST", url + "/clusters/prod/events",
+                  {"type": "broker_drain", "epoch": 2, "brokers": [4]})
+    assert st == 200
+    st, view = _http("POST", url + "/clusters/prod/rollout/start",
+                     {"epoch": 1})
+    assert st == 200 and view["waves"] >= 2
+    _http("POST", url + "/clusters/prod/rollout/advance", {"epoch": 2})
+    st, view = _http("POST", url + "/clusters/prod/rollout/advance",
+                     {"epoch": 3, "canary_ok": True})
+    assert st == 200
+    _, mid = _http("GET", url + "/clusters/prod")
+    truth_mid = mid["assignment"]
+    # the mid-rollout cluster event: a broker is GONE
+    st, body = _http("POST", url + "/clusters/prod/events",
+                     {"type": "broker_remove", "epoch": 3,
+                      "brokers": [4]})
+    assert st == 200
+    _, after = _http("GET", url + "/clusters/prod")
+    # the rollout holds the ground truth: the new plan did NOT merge
+    assert after["assignment"] == truth_mid
+    assert after["plan"] == body["assignment"]
+    st, view = _http("GET", url + "/clusters/prod/rollout")
+    assert view["replans"] == 1
+    assert view["status"] in ("canary", "advancing")
+    # kept waves keep their indices; remaining waves chase the new plan
+    assert view["applied"] == [0]
+    ep = 4
+    while view["status"] in ("canary", "advancing"):
+        extra = ({"canary_ok": True} if view["status"] == "canary"
+                 else {})
+        st, view = _http("POST",
+                         url + "/clusters/prod/rollout/advance",
+                         {"epoch": ep, **extra})
+        assert st == 200, view
+        ep += 1
+    assert view["status"] == "done"
+    _, info = _http("GET", url + "/clusters/prod")
+    assert info["assignment"] == info["plan"]
+    assert _counter(_metrics_text(url),
+                    "kao_rollout_replans_total") >= 1
+
+
+def _metrics_text(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def test_http_rollback_and_stale_epoch_409(rollout_env):
+    tmp_path, url = rollout_env
+    _http("POST", url + "/clusters/prod/events", _bootstrap())
+    _http("POST", url + "/clusters/prod/events",
+          {"type": "broker_drain", "epoch": 2, "brokers": [3]})
+    st, view = _http("POST", url + "/clusters/prod/rollout/start",
+                     {"epoch": 1})
+    assert st == 200
+    _, info = _http("GET", url + "/clusters/prod")
+    base = info["assignment"]
+    _http("POST", url + "/clusters/prod/rollout/advance", {"epoch": 2})
+    st, view = _http("POST", url + "/clusters/prod/rollout/advance",
+                     {"epoch": 3, "canary_ok": True})
+    assert st == 200 and view["applied"] == [0]
+    # stale rollout epoch: structured 409, store untouched
+    store_path = (Path(srv.WATCH["dir"]) / "rollout" / "prod.json")
+    before = store_path.read_bytes()
+    st, err = _http("POST", url + "/clusters/prod/rollout/advance",
+                    {"epoch": 3})
+    assert st == 409
+    assert err["reason"] == "stale_rollout_epoch"
+    assert err["current_rollout_epoch"] == 3
+    assert err["expected_min_epoch"] == 4
+    assert store_path.read_bytes() == before
+    # rollback from a non-terminal wave restores base bit-exactly
+    st, view = _http("POST", url + "/clusters/prod/rollout/rollback",
+                     {"epoch": 4})
+    assert st == 200 and view["status"] == "rolled_back"
+    _, info = _http("GET", url + "/clusters/prod")
+    assert info["assignment"] == base
+    # commands on a terminal rollout are 409 bad_state
+    st, err = _http("POST", url + "/clusters/prod/rollout/advance",
+                    {"epoch": 5})
+    assert st == 409 and err["reason"] == "bad_state"
+    # GET on a cluster with no rollout is a 404
+    st, err = _http("GET", url + "/clusters/other/rollout")
+    assert st == 404
+
+
+def test_cluster_named_rollout_stays_readable(rollout_env):
+    """Review fix: the rollout GET route must not shadow a cluster
+    legitimately named 'rollout'."""
+    tmp_path, url = rollout_env
+    st, _ = _http("POST", url + "/clusters/rollout/events",
+                  _bootstrap())
+    assert st == 200
+    st, info = _http("GET", url + "/clusters/rollout")
+    assert st == 200 and info["cluster_id"] == "rollout"
+    # ...and that cluster's own rollout record is still addressable
+    st, err = _http("GET", url + "/clusters/rollout/rollout")
+    assert st == 404  # none started yet — the route resolved, though
+
+
+def test_rollout_404_and_conflict_mapping(rollout_env):
+    tmp_path, url = rollout_env
+    # unknown cluster: 404 from start
+    st, err = _http("POST", url + "/clusters/ghost/rollout/start",
+                    {"epoch": 1})
+    assert st == 404
+    # known cluster, no certified plan yet -> 409 (bootstrap solves a
+    # plan, so fabricate the edge via a registry with no plan)
+    st, err = _http("POST", url + "/clusters/ghost/rollout/advance",
+                    {"epoch": 1})
+    assert st == 409 and err["reason"] == "bad_state"
+    # malformed body
+    st, err = _http("POST", url + "/clusters/ghost/rollout/start",
+                    {"epoch": -1})
+    assert st == 400
+    # malformed caps are the documented 400 too, never a 422
+    _http("POST", url + "/clusters/capbad/events", _bootstrap())
+    _http("POST", url + "/clusters/capbad/events",
+          {"type": "broker_drain", "epoch": 2, "brokers": [3]})
+    st, err = _http("POST", url + "/clusters/capbad/rollout/start",
+                    {"epoch": 1, "broker_cap": "abc"})
+    assert st == 400, (st, err)
+
+
+# --------------------------------------------------------------------------
+# real HTTP, real SIGKILL: mid-wave restart resumes at the same wave
+# with the same epoch (the PR-7 fresh-port restart harness)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.soak
+@pytest.mark.slow  # ~30 s: two server spawns around a real SIGKILL.
+# The nightly soak runs it; the same durability semantics stay
+# tier-1-covered in-process by
+# test_record_survives_restart_same_wave_same_epoch.
+def test_sigkill_mid_wave_restart_resumes_same_wave(tmp_path):
+    import subprocess
+    import sys
+    import time as _time
+
+    from tests.test_watch import _free_port, _http as _whttp
+
+    def start_server(port, watch_dir, timeout=120):
+        # the PR-7 harness, plus --rollout-broker-cap 1 so the drain
+        # packs into >= 3 waves (a 1-wave rollout would be done before
+        # the kill)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "kafka_assignment_optimizer_tpu.serve",
+             "--port", str(port), "--watch-dir", str(watch_dir),
+             "--workers", "1", "--max-solve-s", "300",
+             "--rollout-broker-cap", "1"],
+            cwd="/root/repo",
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        deadline = _time.time() + timeout
+        url = f"http://127.0.0.1:{port}"
+        while _time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"server died rc={proc.returncode}")
+            try:
+                status, _ = _whttp("GET", url + "/healthz", timeout=5)
+                if status == 200:
+                    return proc, url
+            except Exception:
+                _time.sleep(0.2)
+        proc.kill()
+        raise AssertionError("server never became healthy")
+
+    watch = tmp_path / "watch"
+    proc, url = start_server(_free_port(), watch)
+    try:
+        st, _ = _whttp("POST", url + "/clusters/prod/events",
+                       _bootstrap(B=4, P=8))
+        assert st == 200
+        st, _ = _whttp("POST", url + "/clusters/prod/events",
+                       {"type": "broker_drain", "epoch": 2,
+                        "brokers": [3]})
+        assert st == 200
+        st, v = _whttp("POST", url + "/clusters/prod/rollout/start",
+                       {"epoch": 1})
+        assert st == 200
+        st, v = _whttp("POST", url + "/clusters/prod/rollout/advance",
+                       {"epoch": 2})
+        assert st == 200 and v["status"] == "canary"
+        st, v = _whttp("POST", url + "/clusters/prod/rollout/advance",
+                       {"epoch": 3, "canary_ok": True})
+        assert st == 200
+        wave_index, status, epoch = (v["wave_index"], v["status"],
+                                     v["rollout_epoch"])
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+    # restart on a FRESH port (the killed listener's socket can linger)
+    proc, url = start_server(_free_port(), watch)
+    try:
+        st, v2 = _whttp("GET", url + "/clusters/prod/rollout")
+        assert st == 200
+        assert v2["wave_index"] == wave_index
+        assert v2["status"] == status
+        assert v2["rollout_epoch"] == epoch
+        # the fence survived the kill: a stale command still 409s
+        st, err = _whttp("POST",
+                         url + "/clusters/prod/rollout/advance",
+                         {"epoch": epoch})
+        assert st == 409 and err["reason"] == "stale_rollout_epoch"
+        # and the stream continues from exactly where it stood
+        st, v3 = _whttp("POST",
+                        url + "/clusters/prod/rollout/advance",
+                        {"epoch": epoch + 1})
+        assert st == 200
+        assert v3["applied"][: len(v2["applied"])] == v2["applied"]
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
