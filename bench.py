@@ -248,6 +248,20 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
     from kafka_assignment_optimizer_tpu.api import optimize
     from kafka_assignment_optimizer_tpu.utils import gen
 
+    # device-occupancy sampler (obs.sampler, docs/OBSERVABILITY.md):
+    # --sample-devices threads KAO_SAMPLE_DEVICES into this child so
+    # the headline row carries the measured duty cycle / HBM occupancy
+    # and the sampler's OWN overhead accounting alongside the walls
+    sampler = None
+    if os.environ.get("KAO_SAMPLE_DEVICES"):
+        from kafka_assignment_optimizer_tpu.obs.sampler import SAMPLER
+
+        try:
+            SAMPLER.configure(float(os.environ["KAO_SAMPLE_DEVICES"]))
+            sampler = SAMPLER
+        except ValueError:
+            pass
+
     if smoke:
         sc = gen.SCENARIOS[name](**gen.SMOKE_KWARGS[name])
     else:
@@ -428,7 +442,27 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
              "default_proved_optimal": default_proved}
             if default_wall is not None else {}
         ),
+        **_sampler_block(sampler),
     }
+
+
+def _sampler_block(sampler) -> dict:
+    """The headline row's ``device_sampler`` block (when armed):
+    duty cycle, per-device memory, and the sampler's self-measured
+    overhead fraction — the continuously observed form of the
+    roofline-headroom claim."""
+    if sampler is None:
+        return {}
+    snap = sampler.snapshot()
+    sampler.stop()
+    return {"device_sampler": {
+        "hz": snap["hz"],
+        "samples_total": snap["samples_total"],
+        "overhead_frac": snap["overhead_frac"],
+        "avg_sample_s": snap["avg_sample_s"],
+        "duty_cycle": snap["duty_cycle"],
+        "devices": snap["devices"],
+    }}
 
 
 def run_batch_throughput(smoke: bool, seed: int) -> dict:
@@ -1334,6 +1368,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # portfolio A/B: worst-case quality at equal budget,
         # portfolio-on vs single-config (docs/PORTFOLIO.md)
         line["portfolio_ab"] = portfolio_ab
+    if "device_sampler" in head:
+        # device-occupancy evidence for the headline run: duty cycle,
+        # per-device memory, and the sampler's measured overhead
+        # (docs/OBSERVABILITY.md "Fleet plane")
+        line["device_sampler"] = head["device_sampler"]
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)
@@ -1358,6 +1397,13 @@ def main() -> int:
                          "scenarios twice, then bench.py --compare "
                          "(docs/CONSTRUCTOR.md)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sample-devices", type=float, default=None,
+                    metavar="HZ",
+                    help="arm the device-occupancy sampler "
+                         "(obs.sampler) in every solve child at this "
+                         "rate; the headline row gains a "
+                         "device_sampler block (duty cycle, HBM "
+                         "bytes, measured sampler overhead)")
     ap.add_argument("--kernel", action="store_true",
                     help="also time Pallas kernel vs XLA scorer "
                          "(auto-enabled when the backend is TPU)")
@@ -1496,6 +1542,10 @@ def main() -> int:
         emit(None, "unknown", f"backend resolution failed: {e!r}",
              args.scenario)
         return 0
+    if args.sample_devices:
+        # thread the sampler rate into every solve child (the parent
+        # never initializes a backend, so it never samples itself)
+        env["KAO_SAMPLE_DEVICES"] = str(args.sample_devices)
     print(f"[bench] platform={platform}"
           + (f" (accelerator unavailable: {tpu_err})" if tpu_err else ""),
           file=sys.stderr)

@@ -154,8 +154,19 @@ def request_schema() -> dict:
                                  "(docs/OBSERVABILITY.md)",
             "GET /debug/slo": "SLO engine snapshot: per-class "
                               "objectives, multi-window burn rates, "
-                              "worst-recent exemplars, and the tail "
-                              "of the flight-record stream",
+                              "worst-recent exemplars, drift-alarm "
+                              "state, and the tail of the "
+                              "flight-record stream",
+            "GET /debug/stream": "flight records as newline-delimited "
+                                 "JSON, live as they land "
+                                 "(?follow=0&tail=N for a snapshot; "
+                                 "slow clients shed their own tail, "
+                                 "counted in kao_stream_dropped_total)",
+            "GET /debug/fleet": "this worker's records merged with "
+                                "the --fleet-peers workers: "
+                                "fleet-wide burn rates, drift "
+                                "alarms, per-worker lag "
+                                "(docs/OBSERVABILITY.md, kao-fleet)",
             "GET /schema": "this document",
         },
         "example": {

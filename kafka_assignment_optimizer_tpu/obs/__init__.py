@@ -18,9 +18,19 @@
   ``kao-trace`` offline dump/convert CLI.
 - ``obs.regress`` — noise-aware bench-artifact comparator
   (``bench.py --compare OLD NEW``), the perf-regression gate.
+- ``obs.fleet`` — fleet telemetry plane: merge N workers' flight
+  streams (JSONL dirs or live ``GET /debug/stream``) into one
+  ordered, (worker, seq)-deduped view with fleet-wide burn rates —
+  the ``kao-fleet`` CLI and ``GET /debug/fleet``.
+- ``obs.sampler`` — low-overhead device-occupancy sampler
+  (``--sample-devices HZ``): per-device memory, dispatch duty cycle,
+  per-bucket roofline summary.
+- ``obs.drift`` — EWMA/Page-Hinkley drift alarms on per-class p99 and
+  certify rate over the flight stream (``kao_drift_*``).
 
 See ``docs/OBSERVABILITY.md`` for the trace-ID flow, the flight-record
-schema, SLO configuration, and the metric naming conventions.
+schema, the fleet plane, SLO configuration, and the metric naming
+conventions.
 """
 
 from . import log, trace  # noqa: F401

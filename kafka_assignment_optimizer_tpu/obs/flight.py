@@ -24,6 +24,14 @@ Three sinks, fed by one :func:`record` call:
   archived name (atomic on POSIX), a fresh live file is opened, and
   archives beyond the cap are pruned oldest-first.
 
+Fleet plane (docs/OBSERVABILITY.md "Fleet plane"): every record is
+additionally stamped with this process's worker identity
+(host/pid/port/boot-id) plus a per-worker monotonic ``seq`` — the
+``(worker, seq)`` key the fleet merge (``obs.fleet``) orders and
+dedups on — and fanned out to live-stream subscribers
+(``GET /debug/stream``; bounded per-client queues, slow clients shed
+their own tail) and the drift monitor (``obs.drift``).
+
 Recording must NEVER fail a solve: every sink is wrapped, failures are
 counted (``kao_flight_write_errors_total``) and logged once per breed.
 
@@ -41,8 +49,11 @@ import contextlib
 import contextvars
 import json
 import os
+import queue as _queue
+import socket
 import threading
 import time
+import uuid
 from collections import deque
 
 from . import log as _olog
@@ -57,6 +68,51 @@ SOLVE_BUCKETS = (0.025, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0,
 _RECENT_CAP = 512
 DEFAULT_MAX_BYTES = 8 << 20   # rotate the live JSONL past this
 DEFAULT_MAX_FILES = 4         # archived rotations kept
+
+# live-stream fan-out (GET /debug/stream, docs/OBSERVABILITY.md "Fleet
+# plane"): bounded per-client queues; a slow client sheds its OWN tail
+# (kao_stream_dropped_total), never backpressures the solve path
+MAX_STREAM_CLIENTS = int(os.environ.get("KAO_STREAM_CLIENTS", "8"))
+STREAM_QUEUE_LEN = int(os.environ.get("KAO_STREAM_QUEUE", "256"))
+
+
+# --------------------------------------------------------------------------
+# worker identity + per-worker monotonic sequence (fleet plane)
+# --------------------------------------------------------------------------
+
+# every flight record is stamped with the worker that produced it —
+# host/pid/port/boot-id — plus a per-worker monotonic ``seq``. The
+# fleet merge (obs.fleet) orders WITHIN a worker by seq (immune to that
+# worker's clock) and dedups on (worker, seq); readers treat records
+# without these fields as legacy (single pseudo-worker, file order).
+_WORKER = {
+    "host": socket.gethostname(),
+    "pid": os.getpid(),
+    "port": None,
+    "boot": uuid.uuid4().hex[:8],
+}
+
+
+def worker_identity() -> dict:
+    """This process's worker identity stamp (copied into records)."""
+    return dict(_WORKER)
+
+
+def set_worker_port(port: int | None) -> None:
+    """Serve calls this once the listener is bound, so records name the
+    port peers would use to reach this worker."""
+    _WORKER["port"] = int(port) if port is not None else None
+
+
+def worker_key(rec: dict) -> str:
+    """Stable merge key for the worker that produced ``rec``:
+    ``host:pid:boot`` (port changes on restart reuse; boot-id breaks
+    pid-recycling collisions). Legacy records collapse to one
+    pseudo-worker."""
+    w = rec.get("worker")
+    if not isinstance(w, dict):
+        return "legacy"
+    return f"{w.get('host')}:{w.get('pid')}:{w.get('boot')}"
 
 
 # --------------------------------------------------------------------------
@@ -325,6 +381,91 @@ RECENT: deque = deque(maxlen=_RECENT_CAP)
 # records that entered the STREAM (ring + SLO + histograms) — distinct
 # from the recorder's records_total, which counts only disk appends
 _STREAM_TOTAL = [0]
+# per-worker monotonic sequence, stamped into every record under the
+# same lock that orders the ring — seq order IS ring order
+_SEQ = [0]
+# device-occupancy duty accounting (obs.sampler): cumulative device /
+# dispatch seconds landed by completed solves; the sampler differences
+# these between ticks to derive the dispatch-accumulator duty cycle
+_DUTY_LOCK = threading.Lock()
+_DUTY = {"device_s": 0.0, "dispatch_s": 0.0, "wall_s": 0.0, "solves": 0}
+
+
+def duty_totals() -> dict:
+    with _DUTY_LOCK:
+        return dict(_DUTY)
+
+
+def _note_duty(rec: dict) -> None:
+    split = rec.get("split") or {}
+    with _DUTY_LOCK:
+        _DUTY["device_s"] += float(split.get("device_s") or 0.0)
+        _DUTY["dispatch_s"] += float(split.get("dispatch_s") or 0.0)
+        _DUTY["wall_s"] += float(rec.get("wall_s") or 0.0)
+        _DUTY["solves"] += 1
+
+
+class StreamClient:
+    """One ``GET /debug/stream`` subscriber: a bounded queue the record
+    fan-out offers into. A full queue (slow client) drops the NEWEST
+    record for THIS client only and counts it — the solve path never
+    blocks on a reader."""
+
+    __slots__ = ("_q", "dropped_total")
+
+    def __init__(self, maxlen: int = STREAM_QUEUE_LEN):
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(int(maxlen), 1))
+        self.dropped_total = 0
+
+    def get(self, timeout: float | None = None) -> dict | None:
+        """Next record, or None on timeout (heartbeat opportunity)."""
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def _offer(self, rec: dict) -> None:
+        try:
+            self._q.put_nowait(rec)
+        except _queue.Full:
+            self.dropped_total += 1
+            with _STREAM_LOCK:
+                _STREAM_DROPPED[0] += 1
+
+
+_STREAM_LOCK = threading.Lock()
+_STREAM_CLIENTS: list[StreamClient] = []
+_STREAM_DROPPED = [0]
+
+
+def subscribe(maxlen: int = STREAM_QUEUE_LEN) -> StreamClient:
+    """Register a live-stream subscriber; raises RuntimeError at the
+    client cap (the caller sheds with 503 + Retry-After)."""
+    client = StreamClient(maxlen)
+    with _STREAM_LOCK:
+        if len(_STREAM_CLIENTS) >= MAX_STREAM_CLIENTS:
+            raise RuntimeError(
+                f"stream client cap reached ({MAX_STREAM_CLIENTS}); "
+                "retry later or raise KAO_STREAM_CLIENTS"
+            )
+        _STREAM_CLIENTS.append(client)
+    return client
+
+
+def unsubscribe(client: StreamClient) -> None:
+    with _STREAM_LOCK:
+        try:
+            _STREAM_CLIENTS.remove(client)
+        except ValueError:
+            pass
+
+
+def stream_stats() -> dict:
+    with _STREAM_LOCK:
+        return {
+            "clients": len(_STREAM_CLIENTS),
+            "dropped_total": _STREAM_DROPPED[0],
+        }
 
 
 def configure(directory: str | None, **kw) -> None:
@@ -355,18 +496,34 @@ def reset_recent() -> None:
 
 
 def record(rec: dict) -> None:
-    """Land one flight record on every sink. Never raises."""
+    """Land one flight record on every sink. Never raises.
+
+    Stamps the worker identity + per-worker monotonic ``seq`` here —
+    the ONE funnel every record builder goes through — so the fleet
+    merge key exists on solve, failure, delta, lane, and exact-oracle
+    records alike. Also fans the record out to live-stream subscribers
+    (``GET /debug/stream``) and the drift monitor (``obs.drift``)."""
     try:
         with _RECENT_LOCK:
+            _SEQ[0] += 1
+            rec.setdefault("worker", worker_identity())
+            rec.setdefault("seq", _SEQ[0])
             RECENT.append(rec)
             _STREAM_TOTAL[0] += 1
         RECORDER.write(rec)
+        with _STREAM_LOCK:
+            clients = list(_STREAM_CLIENTS)
+        for c in clients:
+            c._offer(rec)
+        _note_duty(rec)
         observe_solve(rec.get("kind") or "solve",
                       float(rec.get("wall_s") or 0.0),
                       rec.get("trace_id"))
+        from . import drift as _drift
         from . import slo as _slo
 
         _slo.ENGINE.observe_record(rec)
+        _drift.MONITOR.observe_record(rec)
     except Exception as e:  # telemetry must never fail a solve
         _olog.warn("flight_record_failed", error=repr(e)[:200])
 
@@ -608,3 +765,231 @@ def iter_records(path: str):
                         continue  # torn tail / bit rot: skip
         except OSError:
             continue
+
+
+def _parse_lines(chunk: bytes, buf: bytes):
+    """Split ``buf + chunk`` into complete JSON lines; returns
+    (records, remaining_partial). A torn trailing line stays buffered
+    until its newline lands — never parsed early. Bytes in, so byte
+    offsets stay exact for the :func:`snapshot_records` resume
+    handoff."""
+    buf += chunk
+    out = []
+    while b"\n" in buf:
+        line, buf = buf.split(b"\n", 1)
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue  # bit rot mid-stream: skip the line
+    return out, buf
+
+
+def _live_and_dir(path: str) -> tuple[str, str]:
+    if os.path.isdir(path):
+        return os.path.join(path, "flight.jsonl"), path
+    return path, os.path.dirname(path) or "."
+
+
+def _list_archives(dirpath: str) -> list:
+    """[(seq, fullpath, inode)] for the dir's archives, seq-sorted
+    (the writer's zero-padded names make seq == write order)."""
+    rows = []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return rows
+    for name in names:
+        seq = FlightRecorder._archive_seq(name)
+        if seq <= 0:
+            continue
+        full = os.path.join(dirpath, name)
+        try:
+            rows.append((seq, full, os.stat(full).st_ino))
+        except OSError:
+            continue  # pruned between listdir and stat
+    rows.sort()
+    return rows
+
+
+def snapshot_records(path: str) -> tuple[list, tuple]:
+    """Every record currently on disk (archives in write order, then
+    the live file), plus a RESUME token for :func:`follow_records` —
+    the gap-free ``kao-trace flight --tail --follow`` handoff: a
+    record landing between this snapshot and the follow's first read
+    is delivered by the follow, never skipped and never duplicated.
+
+    The token is ``(live_inode, live_byte_offset, seen_archive_seq)``;
+    ``seen_archive_seq`` is captured BEFORE the live read, so a
+    rotation racing the snapshot leaves the rotated-in archive above
+    the watermark for the follower to catch up."""
+    live, dirpath = _live_and_dir(path)
+    archives = _list_archives(dirpath)
+    seen_seq = max((s for s, _f, _i in archives), default=0)
+    records: list = []
+    for _seq, full, _ino in archives:
+        try:
+            with open(full, "rb") as fh:
+                recs, _rest = _parse_lines(fh.read(), b"")
+                records.extend(recs)
+        except OSError:
+            continue
+    ino, offset = None, 0
+    try:
+        with open(live, "rb") as fh:
+            ino = os.fstat(fh.fileno()).st_ino
+            data = fh.read()
+        # resume at the byte after the last COMPLETE line: a torn tail
+        # stays for the follower, which buffers it until the newline
+        offset = data.rfind(b"\n") + 1
+        recs, _rest = _parse_lines(data[:offset], b"")
+        records.extend(recs)
+    except OSError:
+        pass
+    return records, (ino, offset, seen_seq)
+
+
+def follow_records(path: str, *, poll_s: float = 0.2,
+                   stop=None, from_start: bool = False,
+                   resume: tuple | None = None):
+    """``tail -f`` the live flight JSONL, surviving rotation
+    (``kao-trace flight --follow``).
+
+    Rotation contract (matches :meth:`FlightRecorder._rotate_locked`):
+    the writer ``os.replace``s the live file to a ``flight-NNNNNNNN``
+    archive and opens a fresh, EMPTY live file. The follower holds the
+    OLD fd, so on detecting the swap it (1) drains every record still
+    unread from that fd, (2) reads any archives that rotated in SINCE
+    it last looked — a fast writer can rotate several times between
+    polls — skipping the archive whose inode it just drained and
+    anything at or below the highest archive sequence already
+    consumed, then (3) reopens the new live file FROM ITS START, which
+    contains only post-rotation records. A record is therefore never
+    yielded twice; none is skipped short of archive pruning outrunning
+    the follower. Partial trailing lines are buffered until their
+    newline lands.
+
+    ``stop`` is an optional zero-arg callable polled between reads;
+    ``from_start=False`` (the default) begins at the live file's
+    current end, like ``tail -f``; ``resume`` is the token from
+    :func:`snapshot_records` — the follow continues at the exact byte
+    the snapshot stopped at (rotation-safe), so snapshot + follow
+    covers the stream gap-free."""
+    path, dirpath = _live_and_dir(path)
+
+    def _read_archive(full: str, start: int = 0):
+        try:
+            with open(full, "rb") as af:
+                if start:
+                    af.seek(start)
+                recs, _rest = _parse_lines(af.read(), b"")
+                return recs
+        except OSError:
+            return []  # pruned mid-read: its records are gone
+
+    fh = None
+    ino = None
+    buf = b""
+    first_open = True
+    resume_pending = resume is not None
+    if resume is not None:
+        resume_ino, resume_offset, seen_seq = resume
+    else:
+        resume_ino, resume_offset = None, 0
+        # archives present at start are history, never re-read
+        seen_seq = max(
+            (s for s, _f, _i in _list_archives(dirpath)), default=0
+        )
+    while True:
+        if fh is None:
+            try:
+                fh = open(path, "rb")
+                ino = os.fstat(fh.fileno()).st_ino
+            except OSError:
+                fh = None
+            if fh is not None:
+                if resume_pending:
+                    resume_pending = False
+                    if ino == resume_ino:
+                        # no rotation since the snapshot (archives only
+                        # appear via rotation, which changes the live
+                        # inode): continue at the exact byte it
+                        # stopped at
+                        fh.seek(resume_offset)
+                    else:
+                        # rotations since the snapshot: the snapshot's
+                        # live file is an archive now — read it from
+                        # the snapshot offset, newer archives in full;
+                        # the just-opened live file reads from start
+                        for seq, full, a_ino in _list_archives(dirpath):
+                            if a_ino == ino:
+                                # the fd we JUST opened rotated out
+                                # before this listing: it reads these
+                                # bytes itself (from offset 0), so
+                                # reading the archive too would yield
+                                # every record twice
+                                seen_seq = max(seen_seq, seq)
+                                continue
+                            if seq <= seen_seq:
+                                continue
+                            yield from _read_archive(
+                                full,
+                                resume_offset if a_ino == resume_ino
+                                else 0,
+                            )
+                            seen_seq = max(seen_seq, seq)
+                elif first_open and not from_start:
+                    fh.seek(0, os.SEEK_END)
+            first_open = False
+        got = b""
+        if fh is not None:
+            try:
+                got = fh.read()
+            except OSError:
+                got = b""
+            if got:
+                recs, buf = _parse_lines(got, buf)
+                yield from recs
+        if fh is not None and not got:
+            # at EOF of the fd we hold: has the live path moved on?
+            try:
+                cur = os.stat(path).st_ino
+            except OSError:
+                cur = None  # between os.replace and the fresh open
+            if cur != ino:
+                # final drain: the writer may have appended between our
+                # last read and the swap; the archived inode is frozen
+                # now, so read-to-EOF is complete
+                while True:
+                    try:
+                        tail_chunk = fh.read()
+                    except OSError:
+                        break
+                    if not tail_chunk:
+                        break
+                    recs, buf = _parse_lines(tail_chunk, buf)
+                    yield from recs
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+                fh = None
+                buf = b""
+                # catch up on archives that rotated in since the last
+                # look: skip the one we just drained by inode, and
+                # everything already consumed by sequence
+                for seq, full, a_ino in _list_archives(dirpath):
+                    if a_ino == ino:
+                        seen_seq = max(seen_seq, seq)
+                        continue  # the fd above already delivered it
+                    if seq <= seen_seq:
+                        continue
+                    yield from _read_archive(full)
+                    seen_seq = max(seen_seq, seq)
+                continue  # reopen the new live file from its start
+        if stop is not None and stop():
+            return
+        if not got:
+            time.sleep(poll_s)

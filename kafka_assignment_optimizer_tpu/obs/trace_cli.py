@@ -13,11 +13,17 @@ Subcommands:
     List the server's retrievable trace IDs, or fetch one report —
     converted to Chrome trace JSON with ``--chrome``.
 
-``kao-trace flight PATH [--tail N] [--kind K]``
+``kao-trace flight PATH [--tail N] [--kind K] [--follow [--max N]]``
     Dump flight records (one JSON line each) from a flight JSONL file
     or a ``--flight-dir`` directory (archives first, then the live
     file). Torn/corrupt lines are skipped, matching the recorder's
-    crash-safety contract.
+    crash-safety contract. Records carry the worker identity stamp
+    (host/pid/port/boot) and per-worker ``seq`` the fleet merge keys
+    on. ``--follow`` tails the LIVE file like ``tail -f``, surviving
+    the recorder's atomic rotation (the archived file is drained
+    before the fresh live file is opened from its start — no record
+    is ever printed twice or skipped); ``--max N`` exits after N
+    followed records (tests/pipelines), Ctrl-C exits 0.
 
 Exit codes: 0 ok, 2 usage/input error, 3 not found.
 """
@@ -89,22 +95,48 @@ def _cmd_fetch(args) -> int:
 
 
 def _cmd_flight(args) -> int:
-    from .flight import iter_records
+    from .flight import follow_records, iter_records, snapshot_records
 
     if not Path(args.path).exists():
         # kao: disable=KAO106 -- "error: ..." on stderr is the CLI's UX contract
         print(f"error: no such file or directory: {args.path}",
               file=sys.stderr)
         return 3
-    recs = [
-        r for r in iter_records(args.path)
-        if args.kind is None or r.get("kind") == args.kind
-    ]
-    if args.tail:
-        recs = recs[-args.tail:]
-    for r in recs:
-        # kao: disable=KAO106 -- the record stream on stdout IS the product
-        print(json.dumps(r, separators=(",", ":"), default=str))
+    resume = None
+    if args.follow and args.tail:
+        # gap-free handoff: the snapshot returns a resume token (live
+        # inode + byte offset + archive watermark) and the follow
+        # continues at exactly that point, rotation-safe — a record
+        # landing DURING the replay is delivered by the follow, never
+        # skipped and never printed twice
+        recs, resume = snapshot_records(args.path)
+    elif not args.follow:
+        recs = list(iter_records(args.path))
+    else:
+        recs = []
+    if recs:
+        recs = [r for r in recs
+                if args.kind is None or r.get("kind") == args.kind]
+        if args.tail:
+            recs = recs[-args.tail:]
+        for r in recs:
+            # kao: disable=KAO106 -- the record stream on stdout IS the product
+            print(json.dumps(r, separators=(",", ":"), default=str))
+    if not args.follow:
+        return 0
+    printed = 0
+    try:
+        for r in follow_records(args.path, resume=resume):
+            if args.kind is not None and r.get("kind") != args.kind:
+                continue
+            # kao: disable=KAO106 -- the record stream on stdout IS the product
+            print(json.dumps(r, separators=(",", ":"), default=str),
+                  flush=True)
+            printed += 1
+            if args.max is not None and printed >= args.max:
+                break
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -140,6 +172,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="only the last N records")
     fl.add_argument("--kind", default=None,
                     help="filter by record kind (solve/delta/lane)")
+    fl.add_argument("--follow", action="store_true",
+                    help="tail -f the live file (rotation-safe: never "
+                         "double-reads a record); combine with --tail "
+                         "to replay history first")
+    fl.add_argument("--max", type=int, default=None, metavar="N",
+                    help="with --follow: exit after N followed records")
     fl.set_defaults(fn=_cmd_flight)
     return ap
 

@@ -140,8 +140,10 @@ from .analysis import sanitize as _sanitize_mod
 from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
 from .obs import chrome as _ochrome
+from .obs import drift as _odrift
 from .obs import flight as _oflight
 from .obs import log as _olog
+from .obs import sampler as _osampler
 from .obs import slo as _oslo
 from .obs import trace as _otrace
 from .resilience import breaker as _breaker
@@ -284,6 +286,18 @@ OBS = {
     # runs over the record stream either way
     "flight_dir": None,
 }
+# fleet telemetry plane (docs/OBSERVABILITY.md "Fleet plane"):
+# GET /debug/fleet merges THIS worker's record ring with the recent
+# streams of the operator-named peers (--fleet-peers; client-supplied
+# peer URLs are deliberately not accepted — the server must never be
+# pointable at attacker-chosen endpoints). This merged view is the
+# bucket-affinity router's future data source (ROADMAP item 1).
+FLEET = {
+    "peers": [],
+    "timeout_s": 5.0,
+    "tail": 512,
+}
+
 # process start, for the kao_uptime_seconds gauge
 _START_UNIX = time.time()
 # kao_build_info labels, resolved once (jax.devices() initializes the
@@ -665,7 +679,7 @@ _BATCH_SIZES: dict[int, int] = {}
 # pre-declared so /metrics always exposes the family at zero
 _SHED_REASON_NAMES = (
     "queue_full", "service_window", "coalesce_window", "audit_busy",
-    "circuit_open", "deadline", "event_storm",
+    "circuit_open", "deadline", "event_storm", "stream_clients",
 )
 _SHED_REASONS: dict[str, int] = {}
 
@@ -836,6 +850,20 @@ def render_metrics() -> str:
     for k, v in _oflight.snapshot().items():
         if isinstance(v, (int, float)):
             snap[f"flight_{k}"] = v
+    # live-stream fan-out (GET /debug/stream): subscriber count and the
+    # slow-client shed counter — dropped records mean a reader fell
+    # behind its bounded queue, never that the solve path blocked
+    stream = _oflight.stream_stats()
+    snap["stream_clients"] = stream["clients"]
+    snap["stream_dropped_total"] = stream["dropped_total"]
+    # device-occupancy sampler (obs.sampler): cached tick scalars only
+    # — the sampler thread reads the devices, a scrape never touches
+    # jax and never rebuilds the /healthz roofline summary
+    samp = _osampler.SAMPLER.stats()
+    snap["device_sampler_enabled"] = samp["enabled"]
+    snap["device_sampler_samples_total"] = samp["samples_total"]
+    snap["device_sampler_overhead"] = samp["overhead_frac"]
+    snap["device_duty_cycle"] = samp["duty_cycle"]
     # solve-report ring occupancy: the /debug/solves payload bound in
     # action (bytes resident + reports truncated to fit)
     ring = _otrace.RECENT.stats()
@@ -928,6 +956,22 @@ def render_metrics() -> str:
     lines.append("# TYPE kao_degradations_total counter")
     for rung, n in _ladder.snapshot().items():
         lines.append(f'kao_degradations_total{{rung="{rung}"}} {n}')
+    # device memory in use, one gauge per device the sampler saw (CPU
+    # backends report no memory stats, so the family renders empty
+    # there — the HELP/TYPE pair still pre-declares it)
+    lines.append("# HELP kao_device_hbm_bytes device memory in use by "
+                 "device (obs.sampler; --sample-devices)")
+    lines.append("# TYPE kao_device_hbm_bytes gauge")
+    for dev in sorted(samp["devices"]):
+        lines.append(
+            f'kao_device_hbm_bytes{{device="{dev}"}} '
+            f'{samp["devices"][dev]["bytes_in_use"]}'
+        )
+    # drift alarms (obs.drift, docs/OBSERVABILITY.md): the mid-run
+    # "this got slower" tripwire, per record class and signal — the
+    # family renderer is shared with kao-fleet so the two views
+    # cannot drift apart
+    lines.extend(_odrift.render_families(_odrift.MONITOR.metric_rows()))
     # per-phase solve latency histograms, aggregated from solve traces
     # (obs.trace): which pipeline phase the wall-clock goes to, across
     # every traced solve this process has served
@@ -1974,7 +2018,18 @@ def handle_healthz() -> dict:
             "report_ring": _otrace.RECENT.stats(),
             "profile_dir": OBS["profile_dir"],
             "flight": _oflight.snapshot(),
+            # live-stream fan-out + fleet identity (/debug/stream,
+            # /debug/fleet — docs/OBSERVABILITY.md "Fleet plane")
+            "stream": _oflight.stream_stats(),
+            "worker": _oflight.worker_identity(),
+            "fleet_peers": list(FLEET["peers"]),
         },
+        # device-occupancy sampler (--sample-devices; obs.sampler):
+        # per-device memory, the dispatch-accumulator duty cycle, and
+        # the rolling per-bucket roofline summary — the continuously
+        # measured version of the "device is mostly idle" headroom
+        # claim the portfolio lanes spend
+        "devices": _osampler.SAMPLER.snapshot(),
         # the SLO engine's verdict (obs.slo): worst status across
         # classes + per-class burn rates — the one line a fleet
         # health dashboard reads first (full detail: GET /debug/slo)
@@ -2041,6 +2096,58 @@ def _healthz_slo() -> dict:
             for cls, c in (snap.get("classes") or {}).items()
         },
     }
+
+
+def handle_debug_slo() -> dict:
+    """GET /debug/slo — the full SLO snapshot: per-class objectives,
+    multi-window burn rates, worst-recent exemplars, the drift-alarm
+    state (obs.drift), and the tail of the flight-record stream."""
+    return {
+        "slo": _oslo.ENGINE.snapshot(),
+        "flight": _oflight.snapshot(),
+        "drift": _odrift.MONITOR.snapshot(),
+        "exemplars": {
+            "solve_seconds": _oflight.solve_exemplars(),
+            "phase_seconds": _otrace.phase_exemplars(),
+        },
+        "recent_records": _oflight.recent(32),
+    }
+
+
+def handle_fleet_get() -> dict:
+    """GET /debug/fleet — this worker's record ring merged with the
+    recent streams of the --fleet-peers workers (obs.fleet): one
+    ordered, dedup'd view with fleet-wide burn rates, drift alarms,
+    and per-worker lag. A dead peer degrades to an ``errors`` entry,
+    never a 500 — the merged view over the reachable workers still
+    serves."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .obs import fleet as _ofleet
+
+    sources = [("self", _oflight.recent())]
+    errors: dict = {}
+    peers = list(FLEET["peers"])
+    if peers:
+        # fetch peers CONCURRENTLY: N dead peers must cost ~one
+        # timeout on this handler thread, not N stacked timeouts
+        def _fetch(url):
+            return _ofleet.fetch_records(
+                url, tail=FLEET["tail"], timeout=FLEET["timeout_s"],
+            )
+
+        with ThreadPoolExecutor(max_workers=min(len(peers), 8)) as ex:
+            futures = [(url, ex.submit(_fetch, url)) for url in peers]
+            for url, fut in futures:
+                try:
+                    sources.append((url, fut.result()))
+                except Exception as e:
+                    errors[url] = repr(e)[:200]
+    view = _ofleet.build_view(sources, errors=errors or None)
+    view.pop("drift_rows", None)  # exposition-internal detail
+    view["peers"] = list(FLEET["peers"])
+    view["stream"] = _oflight.stream_stats()
+    return view
 
 
 def _healthz_watch() -> dict:
@@ -2505,20 +2612,99 @@ class Handler(BaseHTTPRequestHandler):
                 })
         elif route == "/debug/slo":
             # the full SLO snapshot: per-class objectives, multi-window
-            # burn rates, worst-recent exemplars, and the tail of the
-            # flight-record stream (docs/OBSERVABILITY.md)
-            self._send(200, {
-                "slo": _oslo.ENGINE.snapshot(),
-                "flight": _oflight.snapshot(),
-                "exemplars": {
-                    "solve_seconds": _oflight.solve_exemplars(),
-                    "phase_seconds": _otrace.phase_exemplars(),
-                },
-                "recent_records": _oflight.recent(32),
-            })
+            # burn rates, worst-recent exemplars, drift-alarm state,
+            # and the tail of the flight-record stream
+            # (docs/OBSERVABILITY.md)
+            self._send(200, handle_debug_slo())
+        elif route == "/debug/fleet":
+            # the merged fleet view: this worker + --fleet-peers
+            # (docs/OBSERVABILITY.md "Fleet plane"); peer failures
+            # degrade to an "errors" field inside the handler, so
+            # this always answers 200
+            self._send(200, handle_fleet_get())
+        elif route == "/debug/stream":
+            self._stream_flight()
         else:
             _count(errors_total=1)
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _stream_flight(self) -> None:
+        """GET /debug/stream — flight records as newline-delimited
+        JSON, as they land (docs/OBSERVABILITY.md "Fleet plane").
+
+        Query params: ``follow`` (default 1; 0 = dump the ring tail
+        and close — the snapshot mode /debug/fleet and kao-fleet use),
+        ``tail`` (replay the last N ring records first, default 0 in
+        follow mode / 512 in snapshot mode), ``kind`` (filter).
+
+        Live mode subscribes a bounded per-client queue BEFORE the
+        tail replay and skips queued records the replay already sent
+        (seq-deduped), so a record landing concurrently is delivered
+        exactly once. A slow client overflows its own queue — the
+        newest records are dropped FOR THAT CLIENT ONLY and counted in
+        ``kao_stream_dropped_total``; the solve path never blocks.
+        Blank lines are heartbeats; readers skip them."""
+        from urllib.parse import parse_qs, urlparse
+
+        qs = parse_qs(urlparse(self.path).query)
+
+        def _qint(name: str, default: int) -> int:
+            try:
+                return int((qs.get(name) or [default])[0])
+            except (TypeError, ValueError):
+                return default
+
+        follow = (qs.get("follow") or ["1"])[0] not in ("0", "false")
+        kind = (qs.get("kind") or [None])[0]
+        tail = _qint("tail", 0 if follow else FLEET["tail"])
+        client = None
+        if follow:
+            try:
+                client = _oflight.subscribe()
+            except RuntimeError as e:
+                err = _shed("stream_clients", str(e), retry_after_s=5.0)
+                self._send(err.status,
+                           {"error": str(err), **err.body_extra},
+                           headers={"Retry-After": "5"})
+                return
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            max_seq = 0
+            for rec in (_oflight.recent(tail) if tail > 0 else []):
+                if kind is not None and rec.get("kind") != kind:
+                    continue
+                seq = rec.get("seq")
+                if isinstance(seq, int):
+                    max_seq = max(max_seq, seq)
+                self.wfile.write(json.dumps(
+                    rec, separators=(",", ":"), default=str,
+                ).encode() + b"\n")
+            self.wfile.flush()
+            if not follow:
+                return
+            while True:
+                rec = client.get(timeout=10.0)
+                if rec is None:
+                    # heartbeat: detects a dead socket within ~10 s
+                    # and keeps LB idle timeouts at bay
+                    self.wfile.write(b"\n")
+                else:
+                    seq = rec.get("seq")
+                    if isinstance(seq, int) and seq <= max_seq:
+                        continue  # the tail replay already sent it
+                    if kind is not None and rec.get("kind") != kind:
+                        continue
+                    self.wfile.write(json.dumps(
+                        rec, separators=(",", ":"), default=str,
+                    ).encode() + b"\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away: normal stream teardown
+        finally:
+            if client is not None:
+                _oflight.unsubscribe(client)
 
     def do_POST(self):
         route = self._route()
@@ -2701,6 +2887,24 @@ def main(argv: list[str] | None = None) -> int:
                          "docs/OBSERVABILITY.md. Burn rates on "
                          "/metrics (kao_slo_*), /healthz 'slo', and "
                          "GET /debug/slo")
+    ap.add_argument("--fleet-peers", default=None, metavar="URL,URL",
+                    help="peer worker base URLs for GET /debug/fleet "
+                         "(e.g. 'http://10.0.0.2:8787,"
+                         "http://10.0.0.3:8787'): the merged "
+                         "fleet-wide flight/SLO/drift view "
+                         "(docs/OBSERVABILITY.md). Peers are "
+                         "operator-named only; clients cannot point "
+                         "the server at URLs")
+    ap.add_argument("--sample-devices", type=float, default=None,
+                    metavar="HZ",
+                    help="device-occupancy sampler (obs.sampler; same "
+                         "as KAO_SAMPLE_DEVICES): read jax device "
+                         "memory stats + the dispatch-accumulator "
+                         "duty cycle at this rate into "
+                         "kao_device_hbm_bytes/kao_device_duty_cycle "
+                         "and the /healthz per-bucket roofline "
+                         "summary. Off by default; <1%% overhead at "
+                         "the documented 1 Hz")
     ap.add_argument("--queue-wait-s", type=float,
                     default=DEFAULT_QUEUE_WAIT_S,
                     help="maintenance drain window: how long the "
@@ -2866,6 +3070,24 @@ def main(argv: list[str] | None = None) -> int:
             _oslo.ENGINE.configure(spec=slo_spec)
         except ValueError as e:
             ap.error(f"--slo/KAO_SLO: {e}")
+    if args.fleet_peers:
+        peers = [p.strip().rstrip("/")
+                 for p in args.fleet_peers.split(",") if p.strip()]
+        bad = [p for p in peers
+               if not p.startswith(("http://", "https://"))]
+        if bad:
+            ap.error(f"--fleet-peers URLs must be http(s)://: {bad}")
+        FLEET["peers"] = peers
+    sample_hz = args.sample_devices
+    if sample_hz is None and os.environ.get("KAO_SAMPLE_DEVICES"):
+        try:
+            sample_hz = float(os.environ["KAO_SAMPLE_DEVICES"])
+        except ValueError:
+            ap.error("KAO_SAMPLE_DEVICES must be a number (Hz)")
+    if sample_hz is not None and sample_hz < 0:
+        ap.error("--sample-devices must be >= 0 (0 = off)")
+    if sample_hz:
+        _osampler.SAMPLER.configure(sample_hz)
     _SOLVES.configure(workers=args.workers, depth=args.queue_depth,
                       queue_wait_s=args.queue_wait_s)
     _COALESCER.configure(window_ms=args.batch_window_ms,
@@ -2927,6 +3149,9 @@ def main(argv: list[str] | None = None) -> int:
         lock_wait_s=args.lock_wait_s,
         max_solve_s=args.max_solve_s or None,
     )
+    # stamp the bound port into this worker's flight-record identity
+    # (host/pid/port/boot-id — the fleet merge key, obs.flight)
+    _oflight.set_worker_port(srv.server_address[1])
     if warmup_shapes:
         start_warmup_thread(
             warmup_shapes, max_solve_s=args.max_solve_s or None

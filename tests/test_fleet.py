@@ -1,0 +1,669 @@
+"""Fleet telemetry plane (ISSUE 13, docs/OBSERVABILITY.md "Fleet
+plane"): worker identity + per-worker seq stamping, the live
+/debug/stream fan-out with slow-client shedding, the kao-fleet merge
+(ordering, dedup-on-(worker,seq), torn tails, mid-merge rotation,
+fleet burn-rate equality with the single-stream engine), the
+rotation-surviving --follow tail, the device-occupancy sampler's
+overhead budget, and the EWMA/Page-Hinkley drift alarms."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.obs import drift as odrift
+from kafka_assignment_optimizer_tpu.obs import fleet as ofleet
+from kafka_assignment_optimizer_tpu.obs import flight as oflight
+from kafka_assignment_optimizer_tpu.obs import sampler as osampler
+from kafka_assignment_optimizer_tpu.obs import slo as oslo
+from kafka_assignment_optimizer_tpu.obs import trace as otrace
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _rec(worker: str, seq: int, ts: float, wall_s: float = 0.1,
+         certified: bool = True, kind: str = "solve") -> dict:
+    return {
+        "ts": ts, "kind": kind, "wall_s": wall_s, "seq": seq,
+        "worker": {"host": worker, "pid": 1, "port": 8787,
+                   "boot": worker},
+        "quality": {"feasible": True, "certified": certified},
+    }
+
+
+# --------------------------------------------------------------------------
+# worker identity + seq stamping (satellite 1)
+# --------------------------------------------------------------------------
+
+
+def test_records_stamped_with_worker_identity_and_monotonic_seq():
+    oflight.reset_recent()
+    oflight.record({"ts": time.time(), "kind": "solve", "wall_s": 0.1,
+                    "quality": {"feasible": True, "certified": True}})
+    oflight.record({"ts": time.time(), "kind": "solve", "wall_s": 0.1,
+                    "quality": {"feasible": True, "certified": True}})
+    a, b = oflight.recent()[-2:]
+    for r in (a, b):
+        w = r["worker"]
+        assert w["host"] and isinstance(w["pid"], int) and w["boot"]
+        assert "port" in w  # None until serve binds; key always present
+    assert b["seq"] == a["seq"] + 1
+    # the merge key is stable and boot-scoped
+    assert oflight.worker_key(a) == oflight.worker_key(b)
+    assert oflight.worker_key({}) == "legacy"
+
+
+def test_failure_records_carry_worker_and_seq_too():
+    # record_failure funnels through record(), so an outage burns the
+    # fleet ledger with the same merge key as healthy records
+    oflight.reset_recent()
+    rec = oflight.record_failure(None, None, 0.5, RuntimeError("boom"))
+    assert rec["worker"]["host"] and isinstance(rec["seq"], int)
+    assert rec["quality"]["feasible"] is False
+
+
+# --------------------------------------------------------------------------
+# live-stream fan-out (tentpole 1)
+# --------------------------------------------------------------------------
+
+
+def test_stream_subscriber_bounded_queue_sheds_slow_client():
+    client = oflight.subscribe(maxlen=3)
+    try:
+        before = oflight.stream_stats()["dropped_total"]
+        for i in range(8):
+            oflight.record({"ts": time.time(), "kind": "solve",
+                            "wall_s": 0.1, "i": i,
+                            "quality": {"feasible": True,
+                                        "certified": True}})
+        # the slow client keeps the OLDEST 3 it could queue; the rest
+        # dropped for it alone and counted
+        assert client.dropped_total == 5
+        assert oflight.stream_stats()["dropped_total"] - before == 5
+        got = [client.get(timeout=1.0)["i"] for _ in range(3)]
+        assert got == [0, 1, 2]
+    finally:
+        oflight.unsubscribe(client)
+    assert oflight.stream_stats()["clients"] == 0
+
+
+def test_stream_subscriber_cap():
+    clients = [oflight.subscribe() for _ in range(
+        oflight.MAX_STREAM_CLIENTS - oflight.stream_stats()["clients"]
+    )]
+    try:
+        with pytest.raises(RuntimeError):
+            oflight.subscribe()
+    finally:
+        for c in clients:
+            oflight.unsubscribe(c)
+
+
+def test_http_stream_snapshot_follow_and_fleet_endpoint():
+    """/debug/stream serves NDJSON (snapshot + live follow, with the
+    tail/live dedup), and /debug/fleet serves the merged view."""
+    from kafka_assignment_optimizer_tpu.serve import make_server
+
+    oflight.reset_recent()
+    s = make_server(port=0)
+    t = threading.Thread(target=s.serve_forever, daemon=True)
+    t.start()
+    port = s.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for i in range(5):
+            oflight.record({"ts": time.time(), "kind": "solve",
+                            "wall_s": 0.1, "i": i,
+                            "quality": {"feasible": True,
+                                        "certified": True}})
+        # snapshot mode: dump the tail, close, correct content type
+        with urllib.request.urlopen(
+            base + "/debug/stream?follow=0&tail=512", timeout=30
+        ) as resp:
+            assert resp.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            lines = [json.loads(x)
+                     for x in resp.read().decode().splitlines()
+                     if x.strip()]
+        assert [r["i"] for r in lines] == [0, 1, 2, 3, 4]
+        assert all(isinstance(r.get("seq"), int) for r in lines)
+        # live follow: every record a concurrent "solve" lands arrives
+        got: list = []
+        started = threading.Event()
+
+        def reader():
+            req = urllib.request.urlopen(
+                base + "/debug/stream", timeout=30
+            )
+            started.set()
+            for raw in req:
+                line = raw.decode().strip()
+                if not line:
+                    continue  # heartbeat
+                got.append(json.loads(line))
+                if len(got) >= 3:
+                    req.close()
+                    return
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        assert started.wait(10)
+        time.sleep(0.2)  # let the subscriber register server-side
+        for i in range(3):
+            oflight.record({"ts": time.time(), "kind": "solve",
+                            "wall_s": 0.1, "live": i,
+                            "quality": {"feasible": True,
+                                        "certified": True}})
+        rt.join(timeout=15)
+        assert [r["live"] for r in got] == [0, 1, 2]
+        # the merged self-view: one worker, all eight records
+        with urllib.request.urlopen(base + "/debug/fleet",
+                                    timeout=30) as resp:
+            assert resp.headers.get("Content-Type") == "application/json"
+            view = json.loads(resp.read())
+        assert view["workers"] == 1
+        assert view["records"] == 8
+        assert view["peers"] == []
+        wkey = next(iter(view["per_worker"]))
+        assert view["per_worker"][wkey]["seq_gaps"] == 0
+    finally:
+        s.shutdown()
+        s.server_close()
+
+
+# --------------------------------------------------------------------------
+# fleet merge (tentpole 2 + satellite test coverage)
+# --------------------------------------------------------------------------
+
+
+def _write_worker_dir(tmp_path, name: str, records: list,
+                      max_bytes: int = 1 << 20) -> str:
+    d = str(tmp_path / name)
+    rec = oflight.FlightRecorder()
+    rec.configure(d, max_bytes=max_bytes, max_files=64)
+    for r in records:
+        rec.write(r)
+    return d
+
+
+def test_fleet_merge_three_dirs_interleaved_torn_and_duplicated(
+        tmp_path):
+    """3 synthetic worker dirs with interleaved (and skewed)
+    timestamps, one torn kill-9 tail, and duplicated (worker, seq)
+    rows: the merge orders per-worker by seq, across workers by ts,
+    dedups, and reports per-worker coverage."""
+    # worker a: healthy, ts interleaves with b's
+    a = [_rec("a", i + 1, 100.0 + 2 * i) for i in range(10)]
+    # worker b: clock skewed BACKWARD mid-stream (seq must still rule
+    # within the worker)
+    b = [_rec("b", i + 1, 101.0 + 2 * i) for i in range(10)]
+    b[6]["ts"] = b[4]["ts"] - 0.5  # skew: older ts, newer seq
+    # worker c: will get a torn tail
+    c = [_rec("c", i + 1, 150.0 + i) for i in range(5)]
+    da = _write_worker_dir(tmp_path, "a", a)
+    db = _write_worker_dir(tmp_path, "b", b)
+    dc = _write_worker_dir(tmp_path, "c", c)
+    with open(Path(dc) / "flight.jsonl", "a") as fh:
+        fh.write('{"ts": 999, "seq": 6, "torn')  # the kill -9 tail
+    # a duplicated source: worker a's dir read twice (live snapshot +
+    # archive overlap is the production shape) — dedup on (worker, seq)
+    sources = [
+        (da, list(oflight.iter_records(da))),
+        (db, list(oflight.iter_records(db))),
+        (dc, list(oflight.iter_records(dc))),
+        (da + "-again", list(oflight.iter_records(da))),
+    ]
+    merged, per_worker, dups = ofleet.merge_sources(sources)
+    assert len(merged) == 25  # 10 + 10 + 5; torn tail skipped
+    assert dups == 10         # the duplicated a-dir fully deduped
+    assert set(per_worker) == {"a:1:a", "b:1:b", "c:1:c"}
+    for info in per_worker.values():
+        assert info["seq_gaps"] == 0
+    # per-worker seq order survives the skew: b's records appear in
+    # seq order even though b[6].ts < b[5].ts
+    b_seqs = [r["seq"] for r in merged
+              if oflight.worker_key(r) == "b:1:b"]
+    assert b_seqs == list(range(1, 11))
+    # cross-worker ordering approximates ts: the merged stream's ts is
+    # sorted up to the one deliberate intra-worker skew
+    ts = [r["ts"] for r in merged]
+    unsorted_pairs = sum(1 for x, y in zip(ts, ts[1:]) if y < x)
+    assert unsorted_pairs <= 2
+
+
+def test_fleet_burn_rates_equal_single_engine_on_concatenated_input(
+        tmp_path):
+    """Acceptance: kao-fleet's fleet-wide burn rates over >= 2 worker
+    dirs reproduce the single-process SLO engine's numbers on the
+    concatenated input, class for class and window for window."""
+    now = 10_000.0
+    recs = []
+    for w in ("w1", "w2", "w3"):
+        for i in range(20):
+            # a mix of fast/slow and certified/not, spread so the tail
+            # lands inside the 5m window and everything inside 1h —
+            # both burn windows exercise real counts
+            wall = 8.0 if (i % 5 == 0 and w == "w2") else 0.2
+            certified = not (i % 7 == 0 and w == "w3")
+            kind = "delta" if i % 3 == 0 else "solve"
+            recs.append(_rec(w, i + 1,
+                             now - 3500 + i * 180.0
+                             + {"w1": 0, "w2": 0.3, "w3": 0.7}[w],
+                             wall_s=wall, certified=certified,
+                             kind=kind))
+    dirs = {}
+    for w in ("w1", "w2", "w3"):
+        dirs[w] = _write_worker_dir(
+            tmp_path, w,
+            [r for r in recs if r["worker"]["host"] == w])
+    # reference: ONE engine fed the concatenated input
+    ref = oslo.SLOEngine()
+    for r in recs:
+        ref.observe_record(r)
+    ref_snap = ref.snapshot(now=now)
+    view = ofleet.build_view(
+        [(d, list(oflight.iter_records(d))) for d in dirs.values()],
+        now=now,
+    )
+    assert view["workers"] == 3
+    fleet_snap = view["slo"]
+    assert fleet_snap["classes"].keys() == ref_snap["classes"].keys()
+    for cls, ref_cls in ref_snap["classes"].items():
+        got_cls = fleet_snap["classes"][cls]
+        assert got_cls["events_total"] == ref_cls["events_total"]
+        assert (got_cls["latency_breaches_total"]
+                == ref_cls["latency_breaches_total"])
+        assert (got_cls["quality_breaches_total"]
+                == ref_cls["quality_breaches_total"])
+        assert got_cls["status"] == ref_cls["status"]
+        for win, ref_w in ref_cls["windows"].items():
+            assert got_cls["windows"][win] == ref_w, (cls, win)
+
+
+def test_fleet_merge_tolerates_mid_merge_rotation(tmp_path):
+    """A merge racing the writer's rotation path: every record lands
+    exactly once in the final merge, across several rotations."""
+    d = str(tmp_path / "w")
+    rec = oflight.FlightRecorder()
+    rec.configure(d, max_bytes=4096, max_files=64)
+    stop = threading.Event()
+    mid_merges = []
+
+    def merge_loop():
+        while not stop.is_set():
+            mid_merges.append(
+                ofleet.merge_sources([(d, oflight.iter_records(d))])
+            )
+            time.sleep(0.01)
+
+    t = threading.Thread(target=merge_loop, daemon=True)
+    t.start()
+    for i in range(200):
+        rec.write(_rec("w", i + 1, 100.0 + i, wall_s=0.1))
+    stop.set()
+    t.join(timeout=10)
+    assert rec.snapshot()["rotations_total"] >= 2
+    merged, per_worker, dups = ofleet.merge_sources(
+        [(d, oflight.iter_records(d))]
+    )
+    assert [r["seq"] for r in merged] == list(range(1, 201))
+    assert dups == 0
+    assert per_worker["w:1:w"]["seq_gaps"] == 0
+    # every mid-rotation merge saw an internally consistent prefix:
+    # no duplicates, seqs strictly increasing
+    for m_recs, _pw, m_dups in mid_merges:
+        seqs = [r["seq"] for r in m_recs]
+        assert m_dups == 0
+        assert seqs == sorted(set(seqs))
+
+
+def test_kao_fleet_cli_json_and_metrics(tmp_path):
+    """The kao-fleet console entry over real dirs: the JSON view and
+    an exposition-valid metrics rendering (kao_fleet_* + kao_slo_* +
+    kao_drift_*)."""
+    from tests.test_metrics_format import validate_prometheus
+
+    now = time.time()
+    d1 = _write_worker_dir(
+        tmp_path, "w1", [_rec("w1", i + 1, now - 60 + i)
+                         for i in range(12)])
+    d2 = _write_worker_dir(
+        tmp_path, "w2", [_rec("w2", i + 1, now - 59.5 + i)
+                         for i in range(12)])
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "kafka_assignment_optimizer_tpu.obs.fleet", d1, d2,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr
+    view = json.loads(r.stdout)
+    assert view["workers"] == 2
+    assert view["records"] == 24
+    assert view["duplicates_dropped"] == 0
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "kafka_assignment_optimizer_tpu.obs.fleet", d1, d2,
+         "--format", "metrics"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr
+    samples = validate_prometheus(r.stdout)
+    names = {n for n, _ in samples}
+    assert {"kao_fleet_workers", "kao_fleet_records",
+            "kao_slo_events_total", "kao_slo_burn_rate",
+            "kao_drift_alarms_total"} <= names
+    assert ("kao_fleet_workers", ()) in samples
+    workers = next(ln for ln in r.stdout.splitlines()
+                   if ln.startswith("kao_fleet_workers "))
+    assert workers.endswith(" 2")
+    # an unreadable source is an error + exit 3 when nothing merges
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "kafka_assignment_optimizer_tpu.obs.fleet",
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    assert r.returncode == 3
+
+
+# --------------------------------------------------------------------------
+# kao-trace flight --follow (satellite 2)
+# --------------------------------------------------------------------------
+
+
+def test_follow_records_survives_rotation_never_double_reads(tmp_path):
+    d = str(tmp_path)
+    rec = oflight.FlightRecorder()
+    rec.configure(d, max_bytes=4096, max_files=64)
+    got: list = []
+    stop = threading.Event()
+
+    def run():
+        for r in oflight.follow_records(d, poll_s=0.01,
+                                        stop=stop.is_set):
+            got.append(r["i"])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    for i in range(300):
+        rec.write({"i": i, "pad": "x" * 60})
+    deadline = time.time() + 60
+    while len(got) < 300 and time.time() < deadline:
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=10)
+    assert rec.snapshot()["rotations_total"] >= 2
+    # exactly once, in order, across every rotation
+    assert got == list(range(300))
+
+
+def test_follow_buffers_torn_partial_line(tmp_path):
+    live = tmp_path / "flight.jsonl"
+    live.write_text("")
+    got: list = []
+    stop = threading.Event()
+
+    def run():
+        for r in oflight.follow_records(str(tmp_path), poll_s=0.01,
+                                        stop=stop.is_set):
+            got.append(r)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    with open(live, "a") as fh:
+        fh.write('{"i": 1}\n{"i": 2, "pa')  # torn mid-record
+        fh.flush()
+        time.sleep(0.3)
+        assert [r["i"] for r in got] == [1]  # the torn half waits
+        fh.write('d": "x"}\n')               # the newline lands
+        fh.flush()
+    deadline = time.time() + 10
+    while len(got) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5)
+    assert [r["i"] for r in got] == [1, 2]
+
+
+def test_snapshot_then_follow_is_gap_free_across_rotation(tmp_path):
+    """The --tail --follow handoff: records landing BETWEEN the
+    snapshot and the follow's first read — including across a rotation
+    in that window — are delivered exactly once."""
+    d = str(tmp_path)
+    rec = oflight.FlightRecorder()
+    rec.configure(d, max_bytes=4096, max_files=64)
+    for i in range(120):  # history spanning at least one rotation
+        rec.write({"i": i, "pad": "x" * 60})
+    assert rec.snapshot()["rotations_total"] >= 1
+    history, resume = oflight.snapshot_records(d)
+    assert [r["i"] for r in history] == list(range(120))
+    # the gap window: more records land (forcing another rotation)
+    # BEFORE the follow starts
+    for i in range(120, 240):
+        rec.write({"i": i, "pad": "x" * 60})
+    got: list = []
+    stop = threading.Event()
+
+    def run():
+        for r in oflight.follow_records(d, poll_s=0.01,
+                                        stop=stop.is_set,
+                                        resume=resume):
+            got.append(r["i"])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for i in range(240, 300):  # and more while following
+        rec.write({"i": i, "pad": "x" * 60})
+    deadline = time.time() + 60
+    while len(got) < 180 and time.time() < deadline:
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=10)
+    # exactly the post-snapshot records, in order, none twice
+    assert got == list(range(120, 300))
+
+
+def test_kao_trace_flight_follow_cli(tmp_path):
+    """kao-trace flight --follow --max N: prints records (with their
+    worker/seq stamps) as they land, exits after N."""
+    d = str(tmp_path)
+    rec = oflight.FlightRecorder()
+    rec.configure(d)
+    rec.write(_rec("pre", 1, 1.0))  # history: must NOT print (tail -f)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "kafka_assignment_optimizer_tpu.obs.trace_cli", "flight", d,
+         "--follow", "--max", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(REPO),
+    )
+    try:
+        # keep landing records until the follower has seen its 3 and
+        # exited — robust to slow subprocess startup on this container
+        seq = 2
+        deadline = time.time() + 120
+        while proc.poll() is None and time.time() < deadline:
+            rec.write(_rec("w", seq, float(seq)))
+            seq += 1
+            time.sleep(0.2)
+        out, err = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err
+    lines = [json.loads(x) for x in out.splitlines() if x.strip()]
+    assert len(lines) == 3  # --max honored
+    seqs = [r["seq"] for r in lines]
+    # strictly increasing, never the pre-follow history record
+    assert seqs == sorted(set(seqs)) and seqs[0] >= 2
+    # the worker identity stamp prints with each record (satellite 1)
+    assert all(r["worker"]["host"] == "w" for r in lines)
+
+
+# --------------------------------------------------------------------------
+# drift alarms (tentpole 4)
+# --------------------------------------------------------------------------
+
+
+def test_drift_trips_on_sustained_p99_step_not_on_stable_stream():
+    mon = odrift.DriftMonitor()
+    tripped = []
+    for i in range(60):
+        tripped += mon.observe_record(
+            _rec("w", i + 1, float(i), wall_s=0.1))
+    assert tripped == []  # stable stream: silent
+    for i in range(60):
+        tripped += mon.observe_record(
+            _rec("w", 61 + i, 60.0 + i, wall_s=1.0))
+    assert "p99" in tripped  # a 10x sustained step trips
+    snap = mon.snapshot()
+    assert snap["alarms_total"] >= 1
+    alarm = snap["classes"]["solve"]["p99"]["last_alarm"]
+    assert alarm["value"] == pytest.approx(1.0)
+
+
+def test_drift_single_outlier_immunity():
+    """One 2x outlier rides the rolling p99 for a full window but must
+    NOT trip — the strided updates bound its contribution below lam."""
+    mon = odrift.DriftMonitor()
+    tripped = []
+    for i in range(64):
+        wall = 0.2 if i != 40 else 0.4  # one 2x outlier
+        tripped += mon.observe_record(
+            _rec("w", i + 1, float(i), wall_s=wall))
+    assert tripped == []
+    assert mon.snapshot()["alarms_total"] == 0
+
+
+def test_drift_trips_on_certify_rate_drop():
+    mon = odrift.DriftMonitor()
+    tripped = []
+    for i in range(60):
+        tripped += mon.observe_record(
+            _rec("w", i + 1, float(i), certified=True))
+    assert tripped == []
+    for i in range(60):
+        tripped += mon.observe_record(
+            _rec("w", 61 + i, 60.0 + i, certified=False))
+    assert "certify_rate" in tripped
+    # the latency signal stayed silent: walls never moved
+    assert "p99" not in tripped
+
+
+def test_drift_mark_lands_in_active_trace_and_rearms():
+    mon = odrift.DriftMonitor()
+    for i in range(40):
+        mon.observe_record(_rec("w", i + 1, float(i), wall_s=0.1))
+    tr = otrace.begin(True, name="drift_probe")
+    try:
+        tripped = []
+        for i in range(60):
+            tripped += mon.observe_record(
+                _rec("w", 41 + i, 40.0 + i, wall_s=2.0))
+        assert "p99" in tripped
+    finally:
+        rep = otrace.finish(tr)
+    marks = [s for s in rep["spans"]["spans"]
+             if s["name"] == "drift"]
+    assert marks and marks[0]["attrs"]["signal"] == "p99"
+    assert marks[0]["wall_s"] == 0.0  # zero-duration mark
+    # after the alarm the detector re-baselines at the new level: the
+    # SAME level does not re-trip (one regression = one alarm)
+    before = mon.snapshot()["alarms_total"]
+    for i in range(40):
+        mon.observe_record(_rec("w", 101 + i, 100.0 + i, wall_s=2.0))
+    assert mon.snapshot()["alarms_total"] == before
+
+
+def test_drift_families_on_metrics_and_debug_slo():
+    from kafka_assignment_optimizer_tpu import serve as srv
+    from tests.test_metrics_format import validate_prometheus
+
+    # drive the PROCESS monitor through the real record funnel
+    odrift.MONITOR.reset()
+    for i in range(40):
+        oflight.record({"ts": time.time(), "kind": "solve",
+                        "wall_s": 0.1,
+                        "quality": {"feasible": True,
+                                    "certified": True}})
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    assert {"kao_drift_alarms_total", "kao_drift_ph",
+            "kao_stream_clients", "kao_stream_dropped_total",
+            "kao_device_duty_cycle",
+            "kao_device_sampler_samples_total"} <= names
+    assert any(
+        n == "kao_drift_alarms_total"
+        and ("class", "solve") in labels and ("signal", "p99") in labels
+        for n, labels in samples
+    )
+    slo = srv.handle_debug_slo()
+    assert "drift" in slo
+    assert "solve" in slo["drift"]["classes"]
+    assert slo["drift"]["signals"] == ["p99", "certify_rate"]
+
+
+# --------------------------------------------------------------------------
+# device-occupancy sampler (tentpole 3)
+# --------------------------------------------------------------------------
+
+
+def test_sampler_overhead_budget_and_duty_cycle():
+    """The acceptance budget, measured: per-tick cost far under the
+    <1%-at-1Hz envelope (10 ms/tick == 1%); the duty cycle derives
+    from the flight duty accumulator; stop() is clean."""
+    s = osampler.DeviceSampler()
+    s.configure(50.0)
+    try:
+        deadline = time.time() + 10
+        while s.snapshot()["samples_total"] < 5 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        # land a record claiming heavy device time: the next ticks'
+        # duty-cycle delta must pick it up
+        oflight.record({
+            "ts": time.time(), "kind": "solve", "wall_s": 2.0,
+            "split": {"compile_s": 0.0, "device_s": 1.5,
+                      "dispatch_s": 0.1, "host_s": 0.4},
+            "quality": {"feasible": True, "certified": True},
+        })
+        deadline = time.time() + 10
+        while s.snapshot()["duty_cycle"] == 0.0 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        snap = s.snapshot()
+    finally:
+        s.stop()
+    assert snap["enabled"] == 1
+    assert snap["samples_total"] >= 5
+    assert snap["avg_sample_s"] < 0.010, snap  # 10 ms/tick == 1% @ 1Hz
+    assert snap["duty_cycle"] > 0.0
+    assert snap["hz"] == 50.0
+    # roofline summary: the record above lands in a bucket row
+    assert any(row["device_frac"] > 0
+               for row in snap["roofline"].values())
+    assert osampler.SAMPLER.snapshot()["enabled"] == 0  # global: off
+
+
+def test_sampler_disabled_is_inert_and_healthz_has_devices_section():
+    from kafka_assignment_optimizer_tpu import serve as srv
+
+    snap = osampler.SAMPLER.snapshot()
+    assert snap["enabled"] == 0 and snap["hz"] == 0.0
+    h = srv.handle_healthz()
+    assert "devices" in h
+    assert h["devices"]["enabled"] == 0
+    assert "duty_cycle" in h["devices"]
+    # the fleet identity rides /healthz observability
+    assert h["observability"]["worker"]["host"]
+    assert h["observability"]["fleet_peers"] == []

@@ -206,6 +206,42 @@ def test_metrics_http_content_type():
     validate_prometheus(body)
 
 
+def test_debug_endpoints_declare_json_content_type():
+    """ISSUE 13 satellite: /debug/slo and /debug/solves/<id>
+    (?format=chrome included) declare Content-Type: application/json
+    over real HTTP, alongside the /metrics text-exposition check
+    above — a JSON body served as text/plain breaks strict clients."""
+    import json
+    import threading
+    import urllib.request
+
+    from kafka_assignment_optimizer_tpu.serve import make_server
+
+    # a retrievable solve report for the /debug/solves leg
+    tr = otrace.begin(True, name="ctype_probe")
+    with otrace.span("bounds"):
+        pass
+    rep = otrace.finish(tr)
+    tid = rep["trace_id"]
+    s = make_server(port=0)
+    t = threading.Thread(target=s.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{s.server_address[1]}"
+        for path in ("/debug/slo", f"/debug/solves/{tid}",
+                     f"/debug/solves/{tid}?format=chrome"):
+            with urllib.request.urlopen(base + path,
+                                        timeout=30) as resp:
+                assert resp.headers.get("Content-Type") == \
+                    "application/json", path
+                body = json.loads(resp.read())  # parses as JSON
+        # the chrome response is trace-event JSON, not a solve report
+        assert "traceEvents" in body, list(body)
+    finally:
+        s.shutdown()
+        s.server_close()
+
+
 def test_validator_rejects_malformed_exposition():
     import pytest
 
