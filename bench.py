@@ -1055,6 +1055,325 @@ def run_rollout_bench(smoke: bool, seed: int) -> dict:
     }
 
 
+# --------------------------------------------------------------------------
+# --fleet-bench: router + N real workers vs a single worker (docs/FLEET.md)
+# --------------------------------------------------------------------------
+
+_FLEET_SHAPES = (
+    {"brokers": 12, "partitions": 64, "rf": 3, "racks": 4},
+    {"brokers": 12, "partitions": 200, "rf": 3, "racks": 4},
+)
+
+
+def _fleet_payload(shape: dict, idx: int) -> dict:
+    """One /submit payload in ``shape``'s bucket with REAL repair work:
+    every third partition is piled onto brokers 0-2, violating the
+    balance bands, so the solve has genuine moves to find (a clean
+    round-robin cluster certifies host-side in ~0 work and would
+    measure only HTTP overhead)."""
+    B, rf = shape["brokers"], shape["rf"]
+    parts = []
+    for i in range(shape["partitions"]):
+        if i % 3 == 0:
+            reps = [(i + j * 3) % 9 for j in range(rf)]
+        else:
+            reps = [(i + j) % B for j in range(rf)]
+        parts.append({"topic": "fleet", "partition": i,
+                      "replicas": reps})
+    return {
+        "assignment": {"version": 1, "partitions": parts},
+        "brokers": list(range(B)),
+        "topology": {str(b): f"rack{b % shape['racks']}"
+                     for b in range(B)},
+        "solver": "tpu",
+        "options": {"seed": idx % 5},
+    }
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http_json(url: str, payload=None, timeout: float = 300.0):
+    """(status, body, headers) with 4xx/5xx bodies parsed, not raised."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=(None if payload is None
+              else json.dumps(payload).encode()),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def _wait_up(port: int, deadline_s: float = 240.0) -> None:
+    t0 = time.time()
+    while True:
+        try:
+            _http_json(f"http://127.0.0.1:{port}/healthz", timeout=10)
+            return
+        except Exception as e:
+            if time.time() - t0 > deadline_s:
+                raise RuntimeError(
+                    f"worker :{port} never came up: {e}") from e
+            time.sleep(0.5)
+
+
+def _fleet_load(base_url: str, requests: list[dict],
+                clients: int) -> dict:
+    """Drive ``requests`` closed-loop from ``clients`` threads against
+    ``base_url``/submit, honoring Retry-After on 503 like a
+    well-behaved external client. Returns wall, latency percentiles,
+    per-request moves, and the shed/retry counts — completed MUST
+    equal len(requests) (zero drops)."""
+    import queue as _q
+    import threading
+
+    jobs: _q.Queue = _q.Queue()
+    for i, payload in enumerate(requests):
+        jobs.put((i, payload))
+    lock = threading.Lock()
+    out = {"lat": [], "moves": [], "feasible": 0, "completed": 0,
+           "retries": 0, "errors": []}
+
+    def worker():
+        while True:
+            try:
+                i, payload = jobs.get_nowait()
+            except _q.Empty:
+                return
+            t0 = time.perf_counter()
+            deadline = time.time() + 300.0
+            while True:
+                try:
+                    status, body, headers = _http_json(
+                        f"{base_url}/submit", payload)
+                except Exception as e:  # router/worker hiccup: retry
+                    status, body, headers = 0, {"error": repr(e)}, {}
+                if status == 200:
+                    dt = time.perf_counter() - t0
+                    rep = body.get("report") or {}
+                    with lock:
+                        out["lat"].append(dt)
+                        out["completed"] += 1
+                        out["moves"].append(
+                            rep.get("replica_moves"))
+                        out["feasible"] += bool(rep.get("feasible"))
+                    break
+                if status not in (0, 503):
+                    # a 400/422/500 is a deterministic verdict, not
+                    # saturation: retrying it would spin the full
+                    # per-request deadline per request — fail fast
+                    with lock:
+                        out["errors"].append(
+                            f"{status}: "
+                            f"{str(body.get('error'))[:110]}")
+                    break
+                if time.time() > deadline:
+                    with lock:
+                        out["errors"].append(
+                            str(body.get("error"))[:120])
+                    break
+                try:
+                    wait = float(headers.get("Retry-After", 1))
+                except (TypeError, ValueError):
+                    wait = 1.0
+                with lock:
+                    out["retries"] += 1
+                time.sleep(max(wait, 0.2))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out["wall_s"] = time.perf_counter() - t0
+    return out
+
+
+def run_fleet_bench(smoke: bool, seed: int, env: dict,
+                    n_workers: int = 2) -> dict:
+    """``--fleet-bench`` (docs/FLEET.md, ISSUE 14): spawn a kao-router
+    + N REAL serve workers sharing one fresh ``KAO_COMPILE_CACHE``,
+    fleet-warm the bucket ladder through the router (each bucket
+    compiles exactly once fleet-wide; the spread phase must be all
+    disk hits), then drive an identical mixed-bucket load through the
+    fleet AND through a fresh single worker, reporting aggregate
+    solves/s, p50/p99, the router's affinity hit rate, and the
+    warmup's persistent-compile accounting."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    M = 16 if smoke else 48
+    clients = 4 if smoke else 6
+    requests = [
+        _fleet_payload(_FLEET_SHAPES[i % len(_FLEET_SHAPES)], i + seed)
+        for i in range(M)
+    ]
+    shapes = list(_FLEET_SHAPES)
+    work = tempfile.mkdtemp(prefix="kao-fleet-bench-")
+    procs: list = []
+
+    def spawn_worker(port: int, cache_dir: str):
+        wenv = dict(env)
+        wenv.update({
+            "KAO_COMPILE_CACHE": cache_dir,
+            "KAO_COMPILE_CACHE_MIN_S": "0",
+        })
+        p = subprocess.Popen(
+            [sys.executable, "-m",
+             "kafka_assignment_optimizer_tpu.serve",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--workers", "1", "--queue-depth", "4",
+             "--lock-wait-s", "5", "--max-solve-s", "120",
+             # coalescing OFF for the measurement: batched lane
+             # grouping is timing-sensitive (which requests land in
+             # one dispatch changes the total work), and this harness
+             # needs run-to-run comparability — the coalescing path
+             # has its own dedicated bench (--batch-bench)
+             "--max-batch", "1",
+             "--no-trace"],
+            env=wenv, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        procs.append(p)
+        return p
+
+    def stop_all():
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        procs.clear()
+
+    try:
+        # -- arm 1: the single-worker baseline (own fresh cache) -----
+        sport = _free_port()
+        spawn_worker(sport, os.path.join(work, "jit-single"))
+        _wait_up(sport)
+        t0 = time.perf_counter()
+        status, warm_single, _ = _http_json(
+            f"http://127.0.0.1:{sport}/warmup", {"shapes": shapes},
+            timeout=600,
+        )
+        single_warm_s = time.perf_counter() - t0
+        if status != 200:
+            raise RuntimeError(f"single warmup failed: {warm_single}")
+        single = _fleet_load(f"http://127.0.0.1:{sport}", requests,
+                             clients)
+        stop_all()
+
+        # -- arm 2: router + N workers, ONE shared cache -------------
+        cache = os.path.join(work, "jit-fleet")
+        wports = [_free_port() for _ in range(n_workers)]
+        for p in wports:
+            spawn_worker(p, cache)
+        rport = _free_port()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "kafka_assignment_optimizer_tpu.fleet.router",
+             "--host", "127.0.0.1", "--port", str(rport),
+             "--workers", ",".join(f"http://127.0.0.1:{p}"
+                                   for p in wports),
+             "--health-interval-s", "0.5", "--lock-wait-s", "15"],
+            env=dict(env), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+        for p in wports:
+            _wait_up(p)
+        _wait_up(rport)
+        t0 = time.perf_counter()
+        status, warm_fleet, _ = _http_json(
+            f"http://127.0.0.1:{rport}/warmup", {"shapes": shapes},
+            timeout=900,
+        )
+        fleet_warm_s = time.perf_counter() - t0
+        if status != 200:
+            raise RuntimeError(f"fleet warmup failed: {warm_fleet}")
+        fleet = _fleet_load(f"http://127.0.0.1:{rport}", requests,
+                            clients)
+        _, rhz, _ = _http_json(f"http://127.0.0.1:{rport}/healthz",
+                               timeout=30)
+        stop_all()
+    finally:
+        stop_all()
+        shutil.rmtree(work, ignore_errors=True)
+
+    def pct(xs, q):
+        return round(_pctile(sorted(xs), q), 4) if xs else None
+
+    def thr(arm):
+        return round(arm["completed"] / arm["wall_s"], 3) \
+            if arm["wall_s"] > 0 else None
+
+    affinity = (rhz.get("routing") or {}).get("affinity_rate")
+    fleet_thr, single_thr = thr(fleet), thr(single)
+    # equal quality: both arms solved the identical payloads with the
+    # same seeds — every request feasible, and the same move totals
+    quality_ok = (
+        fleet["completed"] == M and single["completed"] == M
+        and fleet["feasible"] == M and single["feasible"] == M
+        and sorted(x for x in fleet["moves"] if x is not None)
+        == sorted(x for x in single["moves"] if x is not None)
+    )
+    return {
+        "workers": n_workers,
+        "requests": M,
+        "clients": clients,
+        "host_cores": os.cpu_count(),
+        # fleet arm (the headline --compare keys)
+        "throughput": fleet_thr,
+        "p50_s": pct(fleet["lat"], 50),
+        "p99_s": pct(fleet["lat"], 99),
+        "wall_s": round(fleet["wall_s"], 3),
+        "retries": fleet["retries"],
+        "dropped": M - fleet["completed"],
+        # single-worker baseline
+        "single_throughput": single_thr,
+        "single_p50_s": pct(single["lat"], 50),
+        "single_p99_s": pct(single["lat"], 99),
+        "single_dropped": M - single["completed"],
+        "speedup": (round(fleet_thr / single_thr, 3)
+                    if fleet_thr and single_thr else None),
+        # affinity + fleet-warmup accounting (docs/FLEET.md)
+        "affinity_rate": affinity,
+        "affinity_ok": (affinity is not None and affinity >= 0.9),
+        "warmup_fresh_compiles": warm_fleet.get("fresh_compiles"),
+        "warmup_spread_fresh_compiles":
+            warm_fleet.get("spread_fresh_compiles"),
+        # the acceptance proof: non-owner workers' warmup compiled
+        # NOTHING fresh — every executable came off the shared disk
+        # cache one owner populated
+        "spread_ok": warm_fleet.get("spread_fresh_compiles") == 0,
+        "fleet_warm_s": round(fleet_warm_s, 3),
+        "single_warm_s": round(single_warm_s, 3),
+        "quality_ok": quality_ok,
+    }
+
+
 def run_kernel_bench(smoke: bool) -> dict:
     """Time the Pallas scoring kernel (compiled, interpret=False) against
     the pure-XLA scorer on a production-shaped batch. TPU-only: on CPU
@@ -1449,6 +1768,25 @@ def main() -> int:
                          "one-line rollout artifact wired into "
                          "--compare regression keys (same exclusive "
                          "convention as --replay-day)")
+    ap.add_argument("--fleet-bench", action="store_true",
+                    help="run ONLY the fleet-router harness "
+                         "(docs/FLEET.md): spawn a kao-router + 2 "
+                         "REAL serve workers sharing one fresh "
+                         "KAO_COMPILE_CACHE, fleet-warm the bucket "
+                         "ladder through the router (each bucket "
+                         "compiles exactly once fleet-wide), then "
+                         "drive an identical mixed-bucket load "
+                         "through the fleet and through a fresh "
+                         "single worker — aggregate solves/s, "
+                         "p50/p99, affinity hit rate, and the "
+                         "shared-cache warmup accounting; emitted as "
+                         "a one-line fleet artifact wired into "
+                         "--compare regression keys (same exclusive "
+                         "convention as --replay-day)")
+    ap.add_argument("--fleet-workers", type=int, default=2,
+                    metavar="N",
+                    help="worker processes for --fleet-bench "
+                         "(default 2)")
     ap.add_argument("--replay-day", action="store_true",
                     help="run ONLY the event-day replay harness "
                          "(docs/WATCH.md): a scripted day of cluster "
@@ -1488,6 +1826,33 @@ def main() -> int:
         line = {"metric": "replay_day", "platform": platform,
                 "env": _env_stamp(platform, ndev, env),
                 **_compact_replay(rb, eb)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
+
+    if args.fleet_bench:
+        # standalone fleet-router harness (docs/FLEET.md): the parent
+        # stays jax-free — every solve runs inside REAL worker
+        # subprocesses, so no child hop is needed here
+        try:
+            env, platform, tpu_err, ndev = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "fleet_bench",
+                              "error": repr(e)[:300]}))
+            return 0
+        try:
+            fb = run_fleet_bench(args.smoke, args.seed, env,
+                                 n_workers=max(1, args.fleet_workers))
+            ef = None
+        except Exception as e:  # noqa: BLE001 - must emit something
+            fb, ef = None, repr(e)[:300]
+        if fb is not None:
+            print("[bench] FLEET " + json.dumps(fb), file=sys.stderr)
+        line = {"metric": "fleet_bench", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
+                "fleet": fb if fb is not None
+                else {"error": ef or "failed"}}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
         print(json.dumps(line))

@@ -169,6 +169,13 @@ def request_schema() -> dict:
                                 "(docs/OBSERVABILITY.md, kao-fleet)",
             "GET /schema": "this document",
         },
+        "fleet": "run N of these workers behind the kao-router front "
+                 "process for bucket-affinity routing, hedged "
+                 "failover, and fleet-wide warmup over a shared "
+                 "KAO_COMPILE_CACHE (docs/FLEET.md); the router "
+                 "proxies /submit, /evaluate, /warmup and /clusters/* "
+                 "unchanged, so this schema applies behind it "
+                 "verbatim",
         "example": {
             "assignment": DEMO_ASSIGNMENT,
             "brokers": "0-18",

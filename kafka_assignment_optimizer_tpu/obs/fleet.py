@@ -204,12 +204,13 @@ def render_fleet_metrics(view: dict) -> str:
     (now fleet-wide), plus ``kao_fleet_*`` merge gauges and the
     ``kao_drift_*`` families. Validated by the exposition-format test
     suite; every family carries its HELP/TYPE pair (KAO107)."""
+    from . import expo as _expo
+
     lines: list[str] = []
 
     def gauge(name: str, help_text: str, value) -> None:
-        lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {value}")
+        lines.extend(_expo.family_lines(name, "gauge", help_text,
+                                        [(None, value)]))
 
     gauge("kao_fleet_workers", "distinct workers in the merged view",
           view["workers"])
@@ -218,23 +219,20 @@ def render_fleet_metrics(view: dict) -> str:
     gauge("kao_fleet_duplicates",
           "records dropped by (worker, seq) dedup in this merge",
           view["duplicates_dropped"])
-    lines.append("# HELP kao_fleet_lag_seconds seconds since each "
-                 "worker's newest record")
-    lines.append("# TYPE kao_fleet_lag_seconds gauge")
-    for wkey in sorted(view["per_worker"]):
-        lines.append(
-            f'kao_fleet_lag_seconds{{worker="{wkey}"}} '
-            f'{view["per_worker"][wkey]["lag_s"]}'
-        )
-    lines.append("# HELP kao_fleet_seq_gaps per-worker sequence holes "
-                 "the merge never saw (pruned archives, dead workers)")
-    lines.append("# TYPE kao_fleet_seq_gaps gauge")
-    for wkey in sorted(view["per_worker"]):
-        gaps = view["per_worker"][wkey].get("seq_gaps")
-        if gaps is not None:
-            lines.append(
-                f'kao_fleet_seq_gaps{{worker="{wkey}"}} {gaps}'
-            )
+    lines.extend(_expo.family_lines(
+        "kao_fleet_lag_seconds", "gauge",
+        "seconds since each worker's newest record",
+        [({"worker": wkey}, view["per_worker"][wkey]["lag_s"])
+         for wkey in sorted(view["per_worker"])],
+    ))
+    lines.extend(_expo.family_lines(
+        "kao_fleet_seq_gaps", "gauge",
+        "per-worker sequence holes the merge never saw (pruned "
+        "archives, dead workers)",
+        [({"worker": wkey}, view["per_worker"][wkey]["seq_gaps"])
+         for wkey in sorted(view["per_worker"])
+         if view["per_worker"][wkey].get("seq_gaps") is not None],
+    ))
     classes = (view.get("slo") or {}).get("classes") or {}
     if classes:
         slo_families = (

@@ -215,6 +215,12 @@ def _latency_pairs(old: dict, new: dict) -> list[tuple[str, float, float]]:
     oro, nro = old.get("rollout") or {}, new.get("rollout") or {}
     for k in ("pack_s", "replan_s", "total_s"):
         add(f"rollout.{k}", oro.get(k), nro.get(k))
+    # fleet latency: p99 ONLY — p50 and p99 of the same closed-loop
+    # run move together, and two correlated draws must not fill the
+    # suspect quorum as independent evidence (the same reasoning that
+    # excludes the headline fields when scenario rows are present)
+    ofl, nfl = old.get("fleet") or {}, new.get("fleet") or {}
+    add("fleet.p99_s", ofl.get("p99_s"), nfl.get("p99_s"))
     return pairs
 
 
@@ -237,6 +243,12 @@ def _throughput_pairs(old: dict,
         add(f"{sc}.pipeline_speedup",
             orows[sc].get("pipeline_speedup"),
             nrows[sc].get("pipeline_speedup"))
+    # fleet capacity (docs/FLEET.md): aggregate solves/s through the
+    # router. speedup is throughput/single_throughput — correlated
+    # with it, so only one of the pair is compared (quorum honesty)
+    ofl, nfl = old.get("fleet") or {}, new.get("fleet") or {}
+    add("fleet.throughput", ofl.get("throughput"),
+        nfl.get("throughput"))
     return pairs
 
 
@@ -251,6 +263,7 @@ _DETERMINISTIC_KEYS = (
                       "worst_viol_portfolio")),
     ("batch_throughput", ("lanes_feasible", "moves_at_bound")),
     ("rollout", ("caps_ok", "terminal_ok")),
+    ("fleet", ("affinity_ok", "quality_ok", "spread_ok", "dropped")),
 )
 
 
@@ -336,6 +349,21 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
         if oro.get(k) is True and nro.get(k) is False:
             regs.append({"metric": f"rollout.{k}",
                          "old": True, "new": False})
+    # fleet-router quality (docs/FLEET.md): the affinity-rate floor,
+    # the equal-quality verdict, the shared-cache spread proof, and
+    # zero drops are all deterministic — a router that starts routing
+    # cold, duplicating compiles, or dropping requests is a confirmed
+    # regression regardless of wall-clock ratios
+    ofl, nfl = old.get("fleet") or {}, new.get("fleet") or {}
+    for k in ("affinity_ok", "quality_ok", "spread_ok"):
+        if ofl.get(k) is True and nfl.get(k) is False:
+            regs.append({"metric": f"fleet.{k}",
+                         "old": True, "new": False})
+    if (ofl.get("dropped") == 0
+            and isinstance(nfl.get("dropped"), (int, float))
+            and nfl["dropped"] > 0):
+        regs.append({"metric": "fleet.dropped",
+                     "old": 0, "new": nfl["dropped"]})
     return regs
 
 
@@ -467,6 +495,10 @@ def seed_slowdown(artifact: dict, factor: float) -> dict:
         for k in ("ttfc_p50_s", "wall_p50_single_s",
                   "wall_p50_portfolio_s"):
             scale(pa, k, f)
+    fl = art.get("fleet")
+    if isinstance(fl, dict):
+        scale(fl, "p99_s", f)
+        scale(fl, "throughput", 1.0 / f)
     return art
 
 

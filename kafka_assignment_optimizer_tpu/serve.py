@@ -148,6 +148,7 @@ from .obs import slo as _oslo
 from .obs import trace as _otrace
 from .resilience import breaker as _breaker
 from .resilience import budget as _rbudget
+from .utils import platform as _platform
 from .resilience import chaos as _chaos
 from .resilience import ladder as _ladder
 from .rollout import exec as _rexec
@@ -695,13 +696,17 @@ def _shed(reason: str, message: str, retry_after_s: float,
     """Count one load shed and build its 503: the response carries a
     ``Retry-After`` header (and ``retry_after_s``/``reason`` in the
     body) so well-behaved clients back off instead of hammering a
-    saturated service. Callers ``raise _shed(...)``."""
+    saturated service. The body additionally names THIS worker
+    (``worker``, the flight-record identity stamp) so a fleet router
+    attributes the shed to the right peer and fails over with the
+    precise ``retry_after_s`` float instead of the coarse integer
+    header (docs/FLEET.md). Callers ``raise _shed(...)``."""
     with _METRICS_LOCK:
         _SHED_REASONS[reason] = _SHED_REASONS.get(reason, 0) + 1
     return ApiError(
         503, message, retry_after_s=retry_after_s,
         body={"reason": reason, "retry_after_s": round(retry_after_s, 3),
-              **body_extra},
+              "worker": _oflight.worker_identity(), **body_extra},
     )
 
 
@@ -712,11 +717,16 @@ def _breaker_guarded(key: tuple, call):
     (ApiError sheds/validation, model rejections) never do."""
     admitted, retry_after = _BREAKER.allow(key)
     if not admitted:
+        # the bucket key in the body scopes the shed for a fleet
+        # router: other buckets on this worker are still healthy, so
+        # only THIS bucket's traffic should fail over (docs/FLEET.md)
         raise _shed(
             "circuit_open",
             "circuit open for this cluster bucket after repeated "
             "solver failures; retry later",
             retry_after_s=retry_after,
+            **({"bucket": list(key)}
+               if all(isinstance(x, int) for x in key) else {}),
         )
     try:
         out = call()
@@ -1523,6 +1533,7 @@ def handle_submit(
                         "circuit open for this cluster bucket after "
                         "repeated solver failures; retry later",
                         retry_after_s=retry_after,
+                        bucket=list(bucket_key),
                     )
                 entry = {
                     "current": current,
@@ -1993,6 +2004,16 @@ def handle_healthz() -> dict:
             "part_ladder_head": bucket.ladder(10),
             "executables_held": len(mesh._EXECUTABLES),
             "persistent_cache_dir": jax.config.jax_compilation_cache_dir,
+            # shared persistent compile cache traffic (docs/FLEET.md):
+            # hits are executables served from disk (another worker —
+            # or a previous boot — already paid the XLA compile),
+            # misses are fresh compiles this process performed
+            "persistent_cache": _platform.compile_cache_stats(),
+            # the affinity ledger (docs/FLEET.md): bucket keys
+            # (brokers, racks, part-bucket, rf-bucket) this worker has
+            # solved — the kao-router biases routing toward workers
+            # reporting a request's bucket here
+            "warm_buckets": bucket.STATS.seen(),
             # lane consolidation (ISSUE 10): the active lane-padding
             # rungs ([] = padding off), and per bucket the padded width
             # compiled plus the raw batch widths it has served — one
@@ -2304,6 +2325,7 @@ def handle_warmup(
             return time.perf_counter() - t0, res.solve.stats
 
         before = bucket.STATS.snapshot()
+        pc_before = _platform.compile_cache_stats()
         try:
             wall, stats = _SOLVES.submit(
                 _job, wait_s=lock_wait_s, budget_s=max_solve_s
@@ -2313,6 +2335,7 @@ def handle_warmup(
         except Exception as e:
             raise ApiError(500, f"warmup solve failed: {e}") from e
         after = bucket.STATS.snapshot()
+        pc_after = _platform.compile_cache_stats()
         row = {
             "shape": {"brokers": b, "partitions": p, "rf": r, "racks": k},
             "bucket_parts": stats.get("bucket_parts"),
@@ -2327,6 +2350,15 @@ def handle_warmup(
             "already_warm": (
                 after["compiles_total"] == before["compiles_total"]
             ),
+            # persistent-cache movement for this shape (docs/FLEET.md):
+            # with a shared KAO_COMPILE_CACHE, a non-owner worker's
+            # warmup should land ~all hits and ZERO fresh misses — the
+            # fleet-warmup acceptance evidence. Same process-global-
+            # delta caveat as compiles above.
+            "persistent": {
+                "hits": pc_after["hits"] - pc_before["hits"],
+                "misses": pc_after["misses"] - pc_before["misses"],
+            },
         }
         if warm_lanes:
             row.update(_warmup_lanes(
@@ -2513,6 +2545,14 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # which worker answered: the flight-record identity stamp as a
+        # header, so a fleet router (and anything behind it) attributes
+        # every response — success or shed — without parsing the body
+        w = _oflight.worker_identity()
+        self.send_header(
+            "X-KAO-Worker",
+            f"{w['host']}:{w['pid']}:{w['port'] or 0}:{w['boot']}",
+        )
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -2850,6 +2890,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="persistent XLA compile-cache directory "
                          "(sets KAO_JIT_CACHE, so warmth survives "
                          "process restarts)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent XLA compile-cache directory "
+                         "(sets KAO_COMPILE_CACHE; same as --jit-cache "
+                         "— this is the fleet spelling: point every "
+                         "worker at ONE shared dir so one worker's "
+                         "cold compile is every other worker's disk "
+                         "hit, docs/FLEET.md)")
     ap.add_argument("--no-pipeline", action="store_true",
                     help="disable the double-buffered ladder dispatch "
                          "for every solve this service runs "
@@ -3032,6 +3079,10 @@ def main(argv: list[str] | None = None) -> int:
         import os
 
         os.environ["KAO_JIT_CACHE"] = args.jit_cache
+    if args.compile_cache:
+        import os
+
+        os.environ["KAO_COMPILE_CACHE"] = args.compile_cache
     if args.profile_solves < 0:
         ap.error("--profile-solves must be >= 0")
     from .utils.platform import pin_platform

@@ -235,5 +235,17 @@ class CacheStats:
         )
         return out
 
+    def seen(self) -> list:
+        """The bucket keys this process has solved — the affinity
+        ledger the fleet router reads from /healthz "cache" (a seen
+        bucket's executables are warm in-process, modulo the periodic
+        maintenance cache clear, which the next solve re-warms from
+        the persistent disk cache)."""
+        with self._lock:
+            return sorted(
+                list(k) for k in self._seen_buckets
+                if isinstance(k, tuple)
+            )
+
 
 STATS = CacheStats()

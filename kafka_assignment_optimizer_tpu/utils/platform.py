@@ -10,6 +10,7 @@ CPU test mesh). Entry points (CLI, HTTP service, bench) call
 from __future__ import annotations
 
 import os
+import threading
 
 
 def pin_platform(platform: str | None = None) -> None:
@@ -24,6 +25,60 @@ def pin_platform(platform: str | None = None) -> None:
         jax.config.update("jax_platforms", want)
 
 
+# persistent-cache traffic counters (ISSUE 14 satellite): jax reports
+# disk-cache hits/misses as jax.monitoring events, and a fleet sharing
+# one KAO_COMPILE_CACHE dir needs them to PROVE a non-owner worker's
+# warmup compiled nothing fresh (every .compile() call looks the same
+# from bucket.STATS — only the miss counter separates a cold XLA
+# compile from a disk hit). Counted here, surfaced in /healthz "cache"
+# and the /warmup per-shape rows.
+_CACHE_STATS_LOCK = threading.Lock()
+_CACHE_STATS = {"hits": 0, "misses": 0}
+_CACHE_LISTENER_ON = False
+
+
+def _cache_event(name: str, **kw) -> None:
+    if name == "/jax/compilation_cache/cache_hits":
+        with _CACHE_STATS_LOCK:
+            _CACHE_STATS["hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        with _CACHE_STATS_LOCK:
+            _CACHE_STATS["misses"] += 1
+
+
+def compile_cache_stats() -> dict:
+    """Persistent compile-cache state: the configured dir (None while
+    disabled or before the first solve armed it) and the hit/miss
+    traffic this process has generated against it. Reads the already-
+    imported jax module only — a /healthz or router probe must never be
+    the thing that pays the jax import."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    d = None
+    if jax is not None:
+        try:
+            d = jax.config.jax_compilation_cache_dir
+        except Exception:
+            d = None
+    with _CACHE_STATS_LOCK:
+        return {"dir": d, "enabled": bool(d), **_CACHE_STATS}
+
+
+def compile_cache_dir() -> str | None:
+    """The directory :func:`enable_compile_cache` would use (without
+    importing jax or touching the filesystem); None when disabled."""
+    want = os.environ.get("KAO_COMPILE_CACHE",
+                          os.environ.get("KAO_JIT_CACHE", ""))
+    if want.lower() in ("off", "0", "none"):
+        return None
+    return want or os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.expanduser("~/.cache")),
+        "kafka_assignment_optimizer_tpu", "jit",
+    )
+
+
 def enable_compile_cache() -> None:
     """Turn on JAX's persistent compilation cache (idempotent).
 
@@ -31,18 +86,30 @@ def enable_compile_cache() -> None:
     ~25 s to compile in a fresh process and ~4 s with a warm disk cache —
     and the bench harness, the CLI, and the HTTP service each solve in
     fresh processes, so cross-process reuse is the difference between a
-    60 s and a ~15 s cold start. Opt out with ``KAO_JIT_CACHE=off``;
-    override the location with ``KAO_JIT_CACHE=/path``."""
-    want = os.environ.get("KAO_JIT_CACHE", "")
-    if want.lower() in ("off", "0", "none"):
+    60 s and a ~15 s cold start. A serving FLEET points every worker at
+    ONE shared dir (``KAO_COMPILE_CACHE``, docs/FLEET.md) so one
+    worker's cold compile becomes every other worker's disk hit.
+
+    Opt out with ``KAO_COMPILE_CACHE=off``; override the location with
+    ``KAO_COMPILE_CACHE=/path`` (``KAO_JIT_CACHE`` is the legacy
+    spelling and still honored). ``KAO_COMPILE_CACHE_MIN_S`` lowers the
+    persist threshold (default 0.5 s) so small-bucket fleets — whose
+    executables compile fast but still cost a first-contact stall —
+    share warmth too."""
+    path = compile_cache_dir()
+    if path is None:
         return
-    path = want or os.path.join(
-        os.environ.get("XDG_CACHE_HOME",
-                       os.path.expanduser("~/.cache")),
-        "kafka_assignment_optimizer_tpu", "jit",
-    )
     import jax
 
+    global _CACHE_LISTENER_ON
+    if not _CACHE_LISTENER_ON:
+        _CACHE_LISTENER_ON = True
+        try:
+            from jax import monitoring as _mon
+
+            _mon.register_event_listener(_cache_event)
+        except Exception:  # pragma: no cover - monitoring API moved
+            pass
     if jax.config.jax_compilation_cache_dir != path:
         try:
             os.makedirs(path, exist_ok=True)
@@ -53,8 +120,13 @@ def enable_compile_cache() -> None:
 
             _olog.warn("compile_cache_disabled", error=str(e))
             return
+        try:
+            min_s = float(os.environ.get("KAO_COMPILE_CACHE_MIN_S", 0.5))
+        except ValueError:
+            min_s = 0.5
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_s)
 
 
 def ensure_backend() -> str:

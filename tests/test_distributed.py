@@ -68,19 +68,30 @@ def test_mesh_spans_global_devices():
 
 
 @pytest.mark.soak
-@pytest.mark.xfail(
-    strict=False,
-    reason="the jax build in this environment rejects multi-process CPU "
-    "collectives ('Multiprocess computations aren't implemented on the "
-    "CPU backend'); the contract is environment-limited, not broken — "
-    "see docs/ANALYSIS.md (tier-1 triage)",
-)
 def test_two_process_distributed_solve_matches_single_process():
     """VERDICT r3 item 4: actually EXECUTE the multi-host path. Two
     local processes form a real jax.distributed cluster (CPU backend,
     4 forced devices each -> one global 8-device mesh) and run the
     sharded sweep solve end to end through the CLI's ``--distributed``;
-    worker 0's plan must match the single-process 8-device solve."""
+    worker 0's plan must match the single-process 8-device solve.
+
+    Gated on a backend capability probe (ISSUE 14 satellite, per the
+    ROADMAP item-1 note): instead of a blanket ``xfail``, a real
+    2-process collective probe decides — a build that supports
+    multi-process CPU collectives runs the full test, one that does
+    not skips with the probe's own finding as the reason, and a jax
+    upgrade that fixes the limitation starts running this end to end
+    with no test edit."""
+    from kafka_assignment_optimizer_tpu.parallel.distributed import (
+        probe_multiprocess_cpu,
+    )
+
+    supported, finding = probe_multiprocess_cpu()
+    if not supported:
+        pytest.skip(
+            "this jax build cannot run multi-process CPU collectives "
+            f"(capability probe: {finding})"
+        )
     import json
     import os
     import socket

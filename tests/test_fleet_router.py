@@ -1,0 +1,595 @@
+"""Bucket-affinity fleet router tests (docs/FLEET.md, ISSUE 14).
+
+The router is pure stdlib + host-side bucket math, so these tests run
+against FAKE workers — tiny in-process HTTP servers scripted to answer
+/healthz, /submit, /warmup and /clusters like a serve worker would —
+and never import jax (the one subprocess test pins that the router
+module itself doesn't either). Worker-integration behavior (real
+solves through a real fleet) lives in the soak tier and
+``bench.py --fleet-bench``.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.fleet import affinity
+from kafka_assignment_optimizer_tpu.fleet.health import FleetTracker
+from kafka_assignment_optimizer_tpu.fleet.router import (
+    Router,
+    make_router_server,
+    render_router_metrics,
+)
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    Topology,
+    demo_assignment,
+    parse_broker_list,
+)
+
+
+# --------------------------------------------------------------------------
+# host-side bucket key parity with the serve/build_instance path
+# --------------------------------------------------------------------------
+
+
+def _serve_side_key(payload):
+    """The key serve.handle_submit computes: build the real instance."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu import bucket
+
+    current = Assignment.from_dict(payload["assignment"])
+    spec = payload["brokers"]
+    brokers = (parse_broker_list(spec) if isinstance(spec, str)
+               else list(spec))
+    all_ids = sorted(set(brokers) | set(current.broker_ids()))
+    topo_spec = payload.get("topology")
+    if topo_spec is None:
+        topo = None
+    elif topo_spec == "even-odd":
+        topo = Topology.even_odd(all_ids)
+    else:
+        topo = Topology.from_dict(topo_spec)
+    inst = build_instance(current, brokers, topo, payload.get("rf"))
+    return (inst.num_brokers, inst.num_racks, *bucket.bucket_shape(inst))
+
+
+@pytest.mark.parametrize("mutate", [
+    {},                                        # demo verbatim
+    {"topology": None},                        # single rack
+    {"brokers": list(range(12))},              # list form, shrunk
+    {"rf": 2},                                 # int rf override
+    {"rf": {"x.y.z.t": 4}},                    # per-topic rf
+    {"topology": {str(b): f"r{b % 3}" for b in range(19)}},
+])
+def test_bucket_key_matches_build_instance(mutate):
+    """The router's host-side key must equal the key the worker
+    computes when it builds the instance — otherwise affinity routes
+    to the wrong warmth."""
+    payload = {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "topology": "even-odd",
+        **mutate,
+    }
+    assert affinity.bucket_key_of(payload) == _serve_side_key(payload)
+
+
+def test_bucket_key_malformed_is_none():
+    for bad in (
+        {},                                           # nothing
+        {"assignment": "nope", "brokers": "0-3"},     # bad assignment
+        {"assignment": demo_assignment().to_dict()},  # no brokers
+        {"assignment": demo_assignment().to_dict(),
+         "brokers": "0-18", "rf": 99},                # rf > brokers
+        {"assignment": demo_assignment().to_dict(),
+         "brokers": "0-18", "topology": 7},           # bad topology
+    ):
+        assert affinity.bucket_key_of(bad) is None
+
+
+# --------------------------------------------------------------------------
+# rendezvous stability + warmth bias
+# --------------------------------------------------------------------------
+
+
+def test_rendezvous_join_leave_moves_only_owned_keys():
+    """Removing a worker must re-home ONLY the keys it owned; adding
+    it back restores the original owners exactly (the property that
+    makes affinity stable under fleet churn)."""
+    workers = [f"http://w{i}" for i in range(5)]
+    keys = [(19, 2, p, 3) for p in (32, 48, 72, 112, 168, 256, 384)]
+    owner_before = {k: affinity.rendezvous_rank(k, workers)[0]
+                    for k in keys}
+    gone = workers[2]
+    rest = [w for w in workers if w != gone]
+    for k in keys:
+        after = affinity.rendezvous_rank(k, rest)[0]
+        if owner_before[k] != gone:
+            assert after == owner_before[k], (k, after)
+        else:
+            # the orphaned key lands on its previous runner-up
+            assert after == affinity.rendezvous_rank(k, workers)[1]
+    # rejoin restores every original owner
+    assert {k: affinity.rendezvous_rank(k, workers)[0]
+            for k in keys} == owner_before
+
+
+def test_rank_workers_warm_bias_is_stable():
+    workers = [f"http://w{i}" for i in range(4)]
+    key = (19, 2, 32, 3)
+    base = affinity.rendezvous_rank(key, workers)
+    warm_worker = base[-1]  # the rendezvous LOSER is the warm one
+    ranked = affinity.rank_workers(key, workers,
+                                   {warm_worker: {key}})
+    assert ranked[0] == warm_worker
+    # cold group keeps rendezvous order
+    assert ranked[1:] == [w for w in base if w != warm_worker]
+    # no ledger -> pure rendezvous
+    assert affinity.rank_workers(key, workers, {}) == base
+
+
+def test_router_module_never_imports_jax():
+    """The router front process must boot without jax (no backend
+    init, no accelerator deps) — docs/FLEET.md contract."""
+    code = (
+        "import sys;"
+        "import kafka_assignment_optimizer_tpu.fleet.router;"
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+
+
+# --------------------------------------------------------------------------
+# fake workers
+# --------------------------------------------------------------------------
+
+
+class _FakeWorker:
+    """A scripted serve-worker stand-in: answers /healthz with a warm-
+    bucket ledger and /submit//warmup//clusters per its ``mode``."""
+
+    def __init__(self, warm=(), mode="ok", retry_after_s=0.2,
+                 solve_s=0.0, shed_first=0):
+        self.warm = [list(k) for k in warm]
+        self.mode = mode
+        self.retry_after_s = retry_after_s
+        self.solve_s = solve_s
+        self.shed_first = shed_first  # shed the first N posts, then ok
+        self.requests: list = []  # (path, payload)
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, status, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    self._json(200, {
+                        "status": "ok",
+                        "cache": {"warm_buckets": fake.warm},
+                        "queue": {"depth": 0},
+                    })
+                elif self.path.startswith("/clusters"):
+                    self._json(200, {"clusters": {}, "worker": fake.url})
+                else:
+                    self._json(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                with fake._lock:
+                    fake.requests.append((self.path, payload))
+                    n_seen = len(fake.requests)
+                if fake.mode == "shed" or n_seen <= fake.shed_first:
+                    self._json(503, {
+                        "error": "queue full",
+                        "reason": "queue_full",
+                        "retry_after_s": fake.retry_after_s,
+                        "worker": {"host": "fake", "pid": 1},
+                    }, headers={"Retry-After": "1"})
+                    return
+                if fake.solve_s:
+                    time.sleep(fake.solve_s)
+                if self.path == "/warmup":
+                    self._json(200, {"warmed": [
+                        {"shape": sh, "compiles": 1,
+                         "persistent": {"hits": 0, "misses": 1}}
+                        for sh in payload.get("shapes", [])
+                    ]})
+                    return
+                self._json(200, {
+                    "worker": fake.url,
+                    "path": self.path,
+                    "epoch": payload.get("epoch"),
+                    "report": {"feasible": True},
+                })
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        self._thread = threading.Thread(target=self.srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def posts(self, path_prefix="/"):
+        with self._lock:
+            return [(p, b) for p, b in self.requests
+                    if p.startswith(path_prefix)]
+
+    def kill(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _make_router(workers, **kw):
+    tracker = FleetTracker([w.url for w in workers], interval_s=3600,
+                           timeout_s=2.0)
+    tracker.poll_once()
+    router = Router(tracker, lock_wait_s=kw.pop("lock_wait_s", 3.0),
+                    solve_timeout_s=10.0, connect_timeout_s=2.0, **kw)
+    srv = make_router_server("127.0.0.1", 0, router)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    return router, srv, url
+
+
+def _post(url, path, payload, timeout=15.0):
+    req = urllib.request.Request(
+        f"{url}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+DEMO_PAYLOAD = {
+    "assignment": demo_assignment().to_dict(),
+    "brokers": "0-18",
+    "topology": "even-odd",
+    "solver": "tpu",
+}
+DEMO_KEY = affinity.bucket_key_of(DEMO_PAYLOAD)
+
+
+def test_router_routes_to_warm_worker():
+    """A keyed /submit goes to the worker whose /healthz ledger
+    reports the bucket warm — even when rendezvous alone would pick
+    another — and the affinity counters record the hit."""
+    # find which worker rendezvous would pick, then warm the OTHER
+    a, b = _FakeWorker(), _FakeWorker()
+    try:
+        cold_first = affinity.rendezvous_rank(
+            DEMO_KEY, [a.url, b.url])[0]
+        warm_w = b if cold_first == a.url else a
+        warm_w.warm = [list(DEMO_KEY)]
+        router, srv, url = _make_router([a, b])
+        try:
+            router.tracker.poll_once()  # pick up the ledger
+            status, body = _post(url, "/submit", DEMO_PAYLOAD)
+            assert status == 200
+            assert body["worker"] == warm_w.url
+            snap = router.snapshot()
+            assert snap["counters"]["affinity_hits_total"] == 1
+            assert snap["routing"]["affinity_rate"] == 1.0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        a.kill()
+        b.kill()
+
+
+def test_router_failover_on_killed_worker_zero_drops():
+    """SIGKILL-equivalent (listener gone): every request still
+    completes via the surviving worker; the dead worker leaves the
+    routing set and the retry counter records the failovers."""
+    a, b = _FakeWorker(warm=[DEMO_KEY]), _FakeWorker(warm=[DEMO_KEY])
+    router, srv, url = _make_router([a, b])
+    # kill the worker affinity would pick first
+    ranked = affinity.rank_workers(
+        DEMO_KEY, [a.url, b.url], router.tracker.warm_map())
+    dead, alive = (a, b) if ranked[0] == a.url else (b, a)
+    try:
+        dead.kill()
+        results = []
+
+        def client(i):
+            results.append(_post(url, "/submit", DEMO_PAYLOAD))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 6
+        assert all(s == 200 for s, _ in results), results
+        assert all(body["worker"] == alive.url for _, body in results)
+        snap = router.snapshot()
+        assert snap["counters"]["retries_total"]["connect_fail"] >= 1
+        assert dead.url not in snap["fleet"]["live"]
+        assert alive.url in snap["fleet"]["live"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        alive.kill()  # `dead` was killed mid-test
+
+
+def test_router_failover_on_shed_honors_retry_after():
+    """A 503 shed fails over to the next worker AND starts that
+    worker's cooldown: follow-up requests inside the Retry-After
+    window go straight to the healthy worker without re-knocking."""
+    # warm ONLY the shedding worker so it is deterministically
+    # first-ranked and the failover path is what serves the request
+    a = _FakeWorker(warm=[DEMO_KEY], mode="shed", retry_after_s=30.0)
+    b = _FakeWorker()
+    router, srv, url = _make_router([a, b])
+    try:
+        for _ in range(3):
+            status, body = _post(url, "/submit", DEMO_PAYLOAD)
+            assert status == 200
+            assert body["worker"] == b.url
+        # the shedding worker was knocked exactly once: after its
+        # Retry-After promise the router must not re-send traffic
+        assert len(a.posts("/submit")) == 1
+        snap = router.snapshot()
+        assert snap["counters"]["retries_total"]["shed"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+        b.kill()
+
+
+def test_router_waits_out_short_cooldown_instead_of_shedding():
+    """A short Retry-After inside the request's wait budget is slept
+    out by the ROUTER (microsecond-precision float), not surfaced to
+    the client whose header-level backoff floor is a whole second —
+    the request completes on the same worker after its promise
+    expires."""
+    a = _FakeWorker(retry_after_s=0.25, shed_first=1)
+    router, srv, url = _make_router([a], lock_wait_s=5.0)
+    try:
+        t0 = time.perf_counter()
+        status, body = _post(url, "/submit", DEMO_PAYLOAD)
+        dt = time.perf_counter() - t0
+        assert status == 200
+        assert body["worker"] == a.url
+        assert 0.2 <= dt < 2.0, dt  # slept the promise, no 1 s floor
+        snap = router.snapshot()
+        assert snap["counters"]["retries_total"]["cooldown_wait"] >= 1
+        assert snap["counters"]["exhausted_total"] == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+
+
+def test_router_exhausted_returns_503_with_retry_after():
+    a = _FakeWorker(mode="shed", retry_after_s=20.0)
+    router, srv, url = _make_router([a], lock_wait_s=0.5)
+    try:
+        status, body = _post(url, "/submit", DEMO_PAYLOAD)
+        assert status == 503
+        assert body["reason"] == "fleet_exhausted"
+        assert body["retry_after_s"] > 0
+        assert router.snapshot()["counters"]["exhausted_total"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+
+
+def test_watch_cluster_stickiness_single_writer():
+    """Every command for one cluster lands on ONE worker (epoch
+    fencing sees a single writer) regardless of warmth; different
+    clusters may own different workers; a dead owner hands the cluster
+    to the rendezvous runner-up."""
+    a, b = _FakeWorker(warm=[DEMO_KEY]), _FakeWorker(warm=[DEMO_KEY])
+    router, srv, url = _make_router([a, b])
+    try:
+        cids = [f"c{i}" for i in range(8)]
+        for cid in cids:
+            for epoch in (1, 2, 3):
+                status, body = _post(
+                    url, f"/clusters/{cid}/events",
+                    {"type": "bootstrap", "epoch": epoch},
+                )
+                assert status == 200
+        by_worker = {w.url: {p.split("/")[2] for p, _ in
+                             w.posts("/clusters/")}
+                     for w in (a, b)}
+        # one writer per cluster: no cluster id on both workers
+        assert not (by_worker[a.url] & by_worker[b.url])
+        # stickiness matches the rendezvous owner the router promises
+        for cid in cids:
+            owner = affinity.rendezvous_rank(
+                ("cluster", cid), [a.url, b.url])[0]
+            assert cid in by_worker[owner]
+        assert router.snapshot()["counters"]["sticky_total"] == 24
+        # failover: kill a's listener; its clusters re-home to b
+        a_cluster = next(iter(by_worker[a.url]))
+        a.kill()
+        status, body = _post(
+            url, f"/clusters/{a_cluster}/events",
+            {"type": "bootstrap", "epoch": 9},
+        )
+        assert status == 200
+        assert body["worker"] == b.url
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        b.kill()
+
+
+def test_warmup_partition_each_bucket_once_fleetwide():
+    """The router partitions warmup shapes by bucket owner (phase 1 —
+    each bucket compiles exactly once fleet-wide) and spreads the rest
+    to every other worker (phase 2 — shared-cache pulls)."""
+    a, b = _FakeWorker(), _FakeWorker()
+    router, srv, url = _make_router([a, b])
+    try:
+        shapes = [
+            {"brokers": 12, "partitions": 64, "rf": 3, "racks": 4},
+            {"brokers": 12, "partitions": 200, "rf": 3, "racks": 4},
+            {"brokers": 19, "partitions": 64, "rf": 3, "racks": 2},
+        ]
+        status, out = _post(url, "/warmup", {"shapes": shapes})
+        assert status == 200
+        # phase 1: the shape partition covers every shape exactly once
+        part = out["partition"]
+        assert sorted(
+            (sh["brokers"], sh["partitions"])
+            for shs in part.values() for sh in shs
+        ) == sorted((sh["brokers"], sh["partitions"]) for sh in shapes)
+        # and each went to its rendezvous owner over the live set
+        for worker_url, shs in part.items():
+            for sh in shs:
+                key = affinity.shape_key(sh["brokers"],
+                                         sh["partitions"], sh["rf"],
+                                         sh["racks"])
+                assert affinity.rendezvous_rank(
+                    key, [a.url, b.url])[0] == worker_url
+        # phase 2: every worker warms exactly the shapes it does NOT
+        # own (the shared-compile-cache spread)
+        for w in (a, b):
+            own = {(sh["brokers"], sh["partitions"])
+                   for sh in part.get(w.url, [])}
+            posted = [
+                (sh["brokers"], sh["partitions"])
+                for _, body in w.posts("/warmup")
+                for sh in body.get("shapes", [])
+            ]
+            assert sorted(posted) == sorted(
+                [(sh["brokers"], sh["partitions"]) for sh in shapes]
+            ), (w.url, posted)  # own (phase1) + others (phase2) = all
+        # the fake rows report 1 persistent miss per shape, so the
+        # accounting must add up: 3 owned + 3 spread
+        assert out["fresh_compiles"] == 3
+        assert out["spread_fresh_compiles"] == 3
+        # spread="owners" skips phase 2
+        status, out2 = _post(url, "/warmup",
+                             {"shapes": shapes, "spread": "owners"})
+        assert status == 200 and out2["phase2"] == {}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+        b.kill()
+
+
+def test_warmup_error_reads_as_unproven_not_zero():
+    """A worker failing its warmup must surface in ``errors`` AND null
+    out the phase's fresh-compile count — a failed spread can never be
+    mistaken for the '0 fresh compiles' shared-cache proof (the
+    acceptance gates compare against 0; None != 0)."""
+    a, b = _FakeWorker(mode="shed"), _FakeWorker(mode="shed")
+    router, srv, url = _make_router([a, b])
+    try:
+        status, out = _post(url, "/warmup", {"shapes": [
+            {"brokers": 12, "partitions": 64, "rf": 3, "racks": 4},
+            {"brokers": 12, "partitions": 200, "rf": 3, "racks": 4},
+        ]})
+        assert status == 200
+        assert out["errors"], out
+        assert out["fresh_compiles"] is None
+        assert out["spread_fresh_compiles"] is None
+        assert out["spread_fresh_compiles"] != 0  # the gate's read
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+        b.kill()
+
+
+def test_router_healthz_and_metrics_surfaces():
+    a = _FakeWorker(warm=[DEMO_KEY])
+    router, srv, url = _make_router([a])
+    try:
+        _post(url, "/submit", DEMO_PAYLOAD)
+        with urllib.request.urlopen(f"{url}/healthz",
+                                    timeout=10) as resp:
+            hz = json.loads(resp.read())
+        assert hz["role"] == "router"
+        assert hz["fleet"]["workers"][a.url]["warm_buckets"] == [
+            list(DEMO_KEY)
+        ]
+        assert hz["routing"]["affinity_rate"] == 1.0
+        with urllib.request.urlopen(f"{url}/metrics",
+                                    timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            text = resp.read().decode()
+        from tests.test_metrics_format import validate_prometheus
+
+        samples = validate_prometheus(text)
+        names = {n for n, _ in samples}
+        assert {"kao_router_requests_total",
+                "kao_router_affinity_hits_total",
+                "kao_router_affinity_rate",
+                "kao_router_retries_total",
+                "kao_router_worker_up",
+                "kao_router_workers"} <= names, names
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        a.kill()
+
+
+def test_hedge_fires_after_window_and_secondary_wins():
+    """A deadline-carrying /submit whose primary stalls past the hedge
+    window gets a duplicate on the next-ranked worker; the faster
+    answer wins and the hedge counters record it."""
+    # primary: slow (1.5 s); secondary: instant. Warm ONLY the slow
+    # one so it is deterministically ranked first.
+    slow = _FakeWorker(warm=[DEMO_KEY], solve_s=1.5)
+    fast = _FakeWorker()
+    router, srv, url = _make_router([slow, fast], hedge_ms=100.0,
+                                    hedge_budget=2)
+    try:
+        t0 = time.perf_counter()
+        status, body = _post(url, "/submit",
+                             {**DEMO_PAYLOAD, "deadline_s": 30})
+        dt = time.perf_counter() - t0
+        assert status == 200
+        assert body["worker"] == fast.url  # the hedge won
+        assert dt < 1.4, dt  # did not wait out the slow primary
+        snap = router.snapshot()
+        assert snap["counters"]["hedges_total"] == 1
+        assert snap["counters"]["hedge_wins_total"] == 1
+        # without a deadline the same request does NOT hedge
+        status, body = _post(url, "/submit", DEMO_PAYLOAD)
+        assert status == 200 and body["worker"] == slow.url
+        assert router.snapshot()["counters"]["hedges_total"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        slow.kill()
+        fast.kill()

@@ -242,6 +242,47 @@ def test_debug_endpoints_declare_json_content_type():
         s.server_close()
 
 
+def test_router_families_are_valid_exposition():
+    """ISSUE 14 satellite: the kao-router's kao_router_* families —
+    rendered through the shared obs.expo helpers — pass the same
+    validator as every serve surface, with every family pre-declared
+    (HELP/TYPE) even before the first proxied request."""
+    from kafka_assignment_optimizer_tpu.fleet.health import FleetTracker
+    from kafka_assignment_optimizer_tpu.fleet.router import (
+        Router,
+        render_router_metrics,
+    )
+
+    tracker = FleetTracker(
+        ["http://w1:1", "http://w2:2"], interval_s=3600,
+        fetch=lambda u: {"cache": {"warm_buckets": [[19, 2, 32, 3]]}},
+    )
+    tracker.poll_once()
+    router = Router(tracker)
+    # counters move so the labeled families render non-empty rows
+    router._count("requests_total", "submit")
+    router._count("affinity_hits_total")
+    router._count("retries_total", "shed")
+    text = render_router_metrics(router)
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    for fam in ("kao_router_requests_total",
+                "kao_router_affinity_hits_total",
+                "kao_router_affinity_misses_total",
+                "kao_router_affinity_rate",
+                "kao_router_retries_total",
+                "kao_router_hedges_total",
+                "kao_router_hedge_wins_total",
+                "kao_router_sticky_total",
+                "kao_router_exhausted_total",
+                "kao_router_workers",
+                "kao_router_worker_up",
+                "kao_router_worker_warm_buckets"):
+        assert fam in names, fam
+    assert ("kao_router_worker_up",
+            (("worker", "http://w1:1"),)) in samples
+
+
 def test_validator_rejects_malformed_exposition():
     import pytest
 
