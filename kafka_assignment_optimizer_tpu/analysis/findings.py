@@ -34,6 +34,10 @@ RULES: dict[str, str] = {
     "KAO107": "kao_* metric emitted without HELP/TYPE",
     "KAO108": "chaos/resilience hook inside a traced (jit/solver-factory) body",
     "KAO109": "per-partition Python for loop in a bound/reseat hot module",
+    "KAO110": "lane-config value captured as a Python scalar in a "
+              "solver factory",
+    "KAO111": "serve/router outbound HTTP without causal-trace "
+              "injection",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -50,6 +50,17 @@ caught only dynamically, alignment- or platform-dependently):
   factory — bakes the value into the jaxpr and silently
   re-specializes the consolidated executable per config: the exact
   compile-count regression PR 11 exists to prevent.
+- **KAO111** serve/router outbound HTTP without causal-trace
+  injection: the distributed-tracing contract (ISSUE 15,
+  docs/OBSERVABILITY.md "Distributed traces") is that every HTTP call
+  the serving tier makes on behalf of a request carries the active
+  trace context (``obs.trace.inject`` → a ``traceparent`` header) —
+  one uninjected hop and the fleet trace silently loses its worker
+  half. The rule flags outbound-call sites (``conn.request``/
+  ``urlopen``) in ``serve.py`` and ``fleet/`` whose function neither
+  references the injection vocabulary nor threads caller-supplied
+  headers; read-only telemetry fan-outs with no request context carry
+  justified suppressions.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -159,6 +170,7 @@ def lint_source(
     out += _rule_chaos_in_traced(tree, path)
     out += _rule_partition_loop(tree, path, rel)
     out += _rule_lane_config_capture(tree, path)
+    out += _rule_uninjected_http(tree, path, rel)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -713,6 +725,79 @@ def _rule_lane_config_capture(tree, path) -> list[Finding]:
                     "constant and re-specializes the consolidated "
                     "executable per config; keep it a device scalar "
                     "(docs/PORTFOLIO.md)"))
+    return out
+
+
+# ---------------------------------------------------------------- KAO111
+
+# the serving tier whose outbound hops must carry the causal context
+_HTTP_SCOPE_MARKERS = ("serve.py", "fleet/")
+
+
+def _is_outbound_http_call(node: ast.AST) -> bool:
+    """``conn.request(...)`` / ``urlopen(...)`` call sites — the two
+    stdlib outbound-HTTP shapes this tree uses."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("request", "urlopen")
+    return isinstance(fn, ast.Name) and fn.id == "urlopen"
+
+
+def _rule_uninjected_http(tree, path, rel) -> list[Finding]:
+    """Flag serve/fleet functions making outbound HTTP calls without
+    the causal-trace injection vocabulary: no reference to an
+    ``inject``-named helper or a ``traceparent`` literal, and no
+    header-threading parameter (a function that forwards
+    caller-supplied headers delegates propagation to its caller, e.g.
+    the router's ``_proxy_once``). One uninjected hop severs the
+    router→worker trace join (docs/OBSERVABILITY.md "Distributed
+    traces"); genuine non-request traffic (health polls, telemetry
+    fan-outs) carries a justified suppression."""
+    if not any(m in rel for m in _HTTP_SCOPE_MARKERS):
+        return []
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = [
+            n for n in _walk_own_scope(fn)
+            if _is_outbound_http_call(n)
+        ]
+        if not calls:
+            continue
+        params = [
+            a.arg for a in (fn.args.posonlyargs + fn.args.args
+                            + fn.args.kwonlyargs)
+        ]
+        satisfied = any("header" in p for p in params)
+        for node in _walk_own_scope(fn):
+            if satisfied:
+                break
+            if isinstance(node, ast.Name) and "inject" in node.id:
+                satisfied = True
+            elif isinstance(node, ast.Attribute) \
+                    and "inject" in node.attr:
+                satisfied = True
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and "traceparent" in node.value.lower():
+                satisfied = True
+        if satisfied:
+            continue
+        out.extend(
+            Finding(
+                "KAO111", path, call.lineno,
+                f"outbound HTTP call in {fn.name}() without causal-"
+                "trace injection: propagate the active context "
+                "(obs.trace.inject -> a traceparent header, or thread "
+                "the caller's headers through) so the fleet trace "
+                "join survives this hop (docs/OBSERVABILITY.md "
+                "'Distributed traces'); read-only non-request "
+                "traffic should carry a justified suppression")
+            for call in calls
+        )
     return out
 
 

@@ -171,6 +171,9 @@ class FleetTracker:
     # -- polling -----------------------------------------------------
 
     def _fetch_http(self, url: str) -> dict:
+        # background liveness poll: there is no client request (and so
+        # no causal context) to propagate on this hop
+        # kao: disable=KAO111 -- read-only health poll, no active request
         with urllib.request.urlopen(
             f"{url}/healthz", timeout=self.timeout_s
         ) as resp:

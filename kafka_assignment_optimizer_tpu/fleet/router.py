@@ -38,8 +38,33 @@ An HTTP proxy over N ordinary ``serve.py`` workers:
 
 ``GET /healthz`` / ``GET /metrics``
     The router's own state: per-worker liveness/warmth/cooldowns,
-    affinity hit rate, and the ``kao_router_*`` families (shared
-    exposition helpers, validated by tests/test_metrics_format.py).
+    affinity hit rate, and the ``kao_router_*`` + ``kao_trace_*``
+    families (shared exposition helpers, validated by
+    tests/test_metrics_format.py).
+
+``GET /debug/traces`` / ``GET /debug/traces/<trace_id>``
+    The fleet trace store (docs/OBSERVABILITY.md "Distributed
+    traces"): every routed request runs under a causal trace — route
+    decisions, per-worker attempts with their Retry-After verdicts,
+    hedge launches and wins — whose context is ``inject()``-ed into
+    every upstream call as a W3C ``traceparent`` header. Solve
+    traffic (``/submit``) ADOPTS it worker-side, so the solve trace
+    carries the SAME trace ID; cluster commands carry the header but
+    the delta solve keeps its own ID (event coalescing means one
+    solve can serve many clients' events — adopting one would alias
+    the rest, and a fenced event provably births no trace at all) and
+    joins the story via cluster/epoch attrs and ``rollout_root``
+    instead.
+    ``/debug/traces/<id>`` fans ``GET /debug/solves/<id>`` out to the
+    live workers, unions the remote span trees under the router's root
+    span (``obs.causal``), and ``?format=chrome`` exports the merged
+    tree as ONE Perfetto file with per-process track groups — the
+    hedge duplicate's worker included. Clients carrying their own
+    ``traceparent`` are joined to it; responses echo the context and a
+    successful ``/submit`` envelope carries ``route``: the answering
+    worker plus both attempt span IDs (primary + hedge), so a hedge
+    win is attributable client-side. ``KAO_TRACE_TAIL`` arms
+    tail-based retention on the router's ring exactly as on workers.
 
 The router is stdlib-only and never imports jax (pinned by test).
 """
@@ -55,8 +80,11 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import causal as _ocausal
+from ..obs import chrome as _ochrome
 from ..obs import expo as _expo
 from ..obs import log as _olog
+from ..obs import trace as _otrace
 from . import affinity as _aff
 from .health import FleetTracker
 
@@ -111,13 +139,16 @@ class Router:
     # -- low-level proxy ---------------------------------------------
 
     def _proxy_once(self, url: str, method: str, path: str,
-                    body: bytes | None,
-                    timeout: float) -> tuple[int, dict, bytes]:
+                    body: bytes | None, timeout: float,
+                    headers: dict | None = None,
+                    ) -> tuple[int, dict, bytes]:
         """One upstream exchange. Raises OSError-family on transport
         failure; returns (status, headers, body) otherwise. Connect
         runs under the SHORT timeout (a dead host must fail over in
         seconds), then the socket is re-armed with the long read
-        timeout (a solve may legitimately hold the line for minutes)."""
+        timeout (a solve may legitimately hold the line for minutes).
+        ``headers`` carries per-attempt extras — the ``traceparent``
+        context _attempt_one injects (KAO111)."""
         parsed = urllib.parse.urlsplit(url)
         conn_cls = (http.client.HTTPSConnection
                     if parsed.scheme == "https"
@@ -128,8 +159,9 @@ class Router:
             conn.connect()
             if conn.sock is not None:
                 conn.sock.settimeout(timeout)
-            headers = {"Content-Type": "application/json"}
-            conn.request(method, path, body=body, headers=headers)
+            send_headers = {"Content-Type": "application/json",
+                            **(headers or {})}
+            conn.request(method, path, body=body, headers=send_headers)
             resp = conn.getresponse()
             data = resp.read()
             return resp.status, dict(resp.getheaders()), data
@@ -190,14 +222,24 @@ class Router:
     def route(self, method: str, path: str, body: bytes | None, *,
               key=None, sticky: str | None = None,
               hedge: bool = False,
-              timeout: float | None = None) -> tuple[int, dict, bytes]:
+              timeout: float | None = None,
+              info: dict | None = None) -> tuple[int, dict, bytes]:
         """Proxy one request with ranked failover. Returns the first
         non-shed upstream answer (any status — a worker's 400/422/500
         is a real verdict and is relayed), failing over on transport
         errors and 503 sheds while honoring per-worker Retry-After.
         Exhaustion returns a router-originated 503 with the soonest
-        cooldown as Retry-After."""
+        cooldown as Retry-After.
+
+        Runs under the caller's ambient trace when one is active: each
+        ranking pass lands a ``route_decision`` span, each upstream
+        try an ``attempt`` span (its context ``inject()``-ed
+        downstream so the worker's solve tree roots under it), and
+        cooldown sleeps a ``cooldown_wait`` span. ``info`` (when given)
+        collects the attribution the HTTP shell merges into the
+        response envelope: answering worker + both attempt span IDs."""
         timeout = self.solve_timeout_s if timeout is None else timeout
+        parent_sp = _otrace.current_span()
         t_end = time.time() + self.lock_wait_s
         first_choice_counted = False
         soonest = None
@@ -209,6 +251,20 @@ class Router:
             warm = (self.tracker.warm_map()
                     if key is not None and sticky is None else None)
             ranked = self._ranked(key, warm=warm, sticky=sticky)
+            if parent_sp is not None:
+                dsp = _otrace.open_span(parent_sp, "route_decision")
+                _otrace.close_span(
+                    dsp,
+                    bucket=(str(list(key)) if key is not None
+                            else None),
+                    sticky=sticky,
+                    ranked=",".join(ranked),
+                    warm_first=bool(
+                        key is not None and ranked
+                        and tuple(key) in (warm or {}).get(
+                            ranked[0], ())
+                    ),
+                )
             if not ranked:
                 break
             for url in ranked:
@@ -226,7 +282,8 @@ class Router:
                         self._count("affinity_misses_total")
                 out = self._attempt(url, method, path, body, timeout,
                                     key=key, hedge=hedge,
-                                    ranked=ranked)
+                                    ranked=ranked,
+                                    parent_sp=parent_sp, info=info)
                 if out is not None:
                     return out
             # every live worker failed or is cooling down. Cooldowns
@@ -242,8 +299,13 @@ class Router:
             if soonest is None or now + soonest >= t_end:
                 break
             self._count("retries_total", "cooldown_wait")
+            wsp = _otrace.open_span(parent_sp, "cooldown_wait",
+                                    soonest_s=round(soonest, 3))
             time.sleep(min(soonest + 0.01, max(t_end - now, 0.0)))
+            _otrace.close_span(wsp)
         self._count("exhausted_total")
+        if parent_sp is not None:
+            parent_sp.set(exhausted=True)
         retry_after = max(soonest or 1.0, 0.5)
         return 503, {"Retry-After": str(max(1, int(retry_after + 1)))}, \
             json.dumps({
@@ -254,8 +316,10 @@ class Router:
 
     def _attempt(self, url: str, method: str, path: str,
                  body: bytes | None, timeout: float, *, key,
-                 hedge: bool,
-                 ranked: list[str]) -> tuple[int, dict, bytes] | None:
+                 hedge: bool, ranked: list[str],
+                 parent_sp=None,
+                 info: dict | None = None,
+                 ) -> tuple[int, dict, bytes] | None:
         """One (possibly hedged) upstream attempt; None = try the next
         worker."""
         if hedge and self.hedge_budget > 0:
@@ -267,20 +331,41 @@ class Router:
                    if self.tracker.cooling_s(u, key) <= 0.0]
             if nxt:
                 return self._attempt_hedged(url, nxt[0], method, path,
-                                            body, timeout, key=key)
+                                            body, timeout, key=key,
+                                            parent_sp=parent_sp,
+                                            info=info)
         return self._attempt_one(url, method, path, body, timeout,
-                                 key=key)
+                                 key=key, parent_sp=parent_sp,
+                                 info=info)
 
     def _attempt_one(self, url: str, method: str, path: str,
                      body: bytes | None, timeout: float,
-                     *, key) -> tuple[int, dict, bytes] | None:
+                     *, key, parent_sp=None, hedge: bool = False,
+                     info: dict | None = None, span=None,
+                     ) -> tuple[int, dict, bytes] | None:
+        sp = span if span is not None else _otrace.open_span(
+            parent_sp, "attempt", worker=url, hedge=hedge)
+        inject_headers = None
+        if sp is not None:
+            if info is not None:
+                # recorded at LAUNCH, not at success: a failed primary
+                # and its winning hedge must BOTH be attributable
+                info["hedge_span_id" if hedge
+                     else "primary_span_id"] = sp.sid()
+            # causal propagation (KAO111): the worker-side solve trace
+            # roots under exactly THIS attempt span
+            tp = _otrace.inject(sp.trace.trace_id, sp.sid())
+            if tp:
+                inject_headers = {_otrace.TRACEPARENT: tp}
         try:
             status, headers, data = self._proxy_once(
                 url, method, path, body, timeout,
+                headers=inject_headers,
             )
-        except Exception:
+        except Exception as e:
             self.tracker.note_result(url, ok=False)
             self._count("retries_total", "connect_fail")
+            _otrace.close_span(sp, error=repr(e)[:200])
             return None
         self.tracker.note_result(url, ok=True)
         shed = self._shed_info(status, headers, data)
@@ -291,14 +376,24 @@ class Router:
                 bucket=bucket if bucket is not None else None,
             )
             self._count("retries_total", "shed")
+            _otrace.close_span(sp, status=status, shed=True,
+                               retry_after_s=round(retry_after, 3))
             return None
         self._count("proxied_total")
+        if info is not None:
+            info["worker"] = url
+            wid = headers.get("X-KAO-Worker")
+            if wid:
+                info["worker_identity"] = wid
+            info["answered_by_hedge"] = hedge
+        _otrace.close_span(sp, status=status)
         return status, headers, data
 
     def _attempt_hedged(self, primary: str, secondary: str,
                         method: str, path: str, body: bytes | None,
-                        timeout: float,
-                        *, key) -> tuple[int, dict, bytes] | None:
+                        timeout: float, *, key, parent_sp=None,
+                        info: dict | None = None,
+                        ) -> tuple[int, dict, bytes] | None:
         """Race ``primary`` against a delayed duplicate on
         ``secondary``: fire the duplicate only after ``hedge_s``
         without an answer and only inside the concurrent-hedge budget.
@@ -306,11 +401,18 @@ class Router:
         cost of the tail latency saved."""
         results: list = []
         done = threading.Condition()
+        # per-slot attribution scratch: the racing threads never write
+        # one shared dict (the loser finishing late must not overwrite
+        # the winner's attribution); the winner's entry merges below
+        infos: list[dict] = [{}, {}]
 
-        def run(u, slot, release_token=False):
+        def run(u, slot, span, hedge=False, release_token=False):
             try:
                 out = self._attempt_one(u, method, path, body,
-                                        timeout, key=key)
+                                        timeout, key=key,
+                                        parent_sp=parent_sp,
+                                        hedge=hedge, info=infos[slot],
+                                        span=span)
             finally:
                 if release_token:
                     # the duplicate's budget token is held for as long
@@ -322,9 +424,27 @@ class Router:
                 results.append((slot, out))
                 done.notify_all()
 
-        threading.Thread(target=run, args=(primary, 0),
-                         daemon=True).start()
+        def launch(u, slot, hedge=False, release_token=False):
+            # open the attempt span (and stamp its ID into the slot's
+            # attribution scratch) BEFORE Thread.start(): the winner's
+            # merge below may run before the OS ever schedules the
+            # loser's thread, and the envelope must still carry both
+            # attempt span IDs
+            sp = _otrace.open_span(parent_sp, "attempt", worker=u,
+                                   hedge=hedge)
+            if sp is not None:
+                infos[slot]["hedge_span_id" if hedge
+                            else "primary_span_id"] = sp.sid()
+            threading.Thread(
+                target=run, args=(u, slot, sp),
+                kwargs={"hedge": hedge,
+                        "release_token": release_token},
+                daemon=True,
+            ).start()
+
+        launch(primary, 0)
         launched = 1
+        hedged = False
         with done:
             done.wait(self.hedge_s)
             if not results:
@@ -334,19 +454,49 @@ class Router:
                         self._hedges_inflight += 1
                 if can:
                     self._count("hedges_total")
-                    threading.Thread(
-                        target=run, args=(secondary, 1, True),
-                        daemon=True,
-                    ).start()
+                    hedged = True
+                    if parent_sp is not None:
+                        # the duplicate race is itself a tail-retention
+                        # signal (TailPolicy keeps hedged traces full)
+                        parent_sp.set(hedged=True)
+                        _otrace.close_span(_otrace.open_span(
+                            parent_sp, "hedge_launch",
+                            secondary=secondary,
+                        ))
+                    launch(secondary, 1, hedge=True,
+                           release_token=True)
                     launched = 2
+
+            def merge_attribution(slot: int) -> None:
+                if info is None:
+                    return
+                if "primary_span_id" in infos[0]:
+                    info["primary_span_id"] = infos[0][
+                        "primary_span_id"]
+                if "hedge_span_id" in infos[1]:
+                    info["hedge_span_id"] = infos[1]["hedge_span_id"]
+                for k in ("worker", "worker_identity",
+                          "answered_by_hedge"):
+                    if k in infos[slot]:
+                        info[k] = infos[slot][k]
+                if hedged:
+                    info["hedge_won"] = (slot == 1)
+
             while True:
                 for slot, out in results:
                     if out is not None:
                         if slot == 1:
                             self._count("hedge_wins_total")
+                        merge_attribution(slot)
+                        if hedged and parent_sp is not None:
+                            parent_sp.set(hedge_won=(slot == 1))
                         return out
                 if len(results) >= launched:
-                    return None  # every launched attempt failed
+                    # every launched attempt failed: merge NOTHING —
+                    # route() fails over, and a later worker's
+                    # successful plain attempt must not inherit this
+                    # dead race's hedge_span_id/hedge_won
+                    return None
                 done.wait()
 
     # -- warmup orchestration ----------------------------------------
@@ -577,7 +727,14 @@ def render_router_metrics(router: Router) -> str:
          "per-worker warm-bucket ledger size",
          [({"worker": u}, len(w["warm_buckets"]))
           for u, w in sorted(fleet["workers"].items())]),
+        ("kao_router_trace_reports", "gauge",
+         "route traces resident in the router's ring (the fleet "
+         "trace store behind GET /debug/traces)",
+         [(None, _otrace.RECENT.stats()["reports"])]),
     ]
+    # the shared kao_trace_* families (tail retention + traceparent
+    # codec traffic) — same shape the workers render
+    fams.extend(_otrace.trace_families())
     return _expo.render(fams)
 
 
@@ -634,6 +791,72 @@ class RouterHandler(BaseHTTPRequestHandler):
     def _route(self) -> str:
         return self.path.split("?", 1)[0].rstrip("/") or "/"
 
+    def _trace_begin(self, name: str, **attrs):
+        """Begin the request's causal trace: adopt a client-supplied
+        ``traceparent`` (remote-parented root) or open a fresh root.
+        Returns ``(trace, remote_ctx)`` — ``(None, None)`` when router
+        tracing is off (--no-trace)."""
+        if not getattr(self.server, "trace", True):
+            return None, None
+        ctx = _otrace.extract(self.headers.get(_otrace.TRACEPARENT))
+        tr = _otrace.begin(
+            ctx.trace_id if ctx else True, name=name,
+            remote_parent=ctx.span_id if ctx else None, **attrs,
+        )
+        if tr is not None:
+            # mint the root's span ID NOW, before finish() snapshots
+            # the report: the traceparent echoed after the relay
+            # references this ID, so it must exist in the stored tree
+            tr.root.sid()
+        return tr, ctx
+
+    def _finish_trace(self, tr, out) -> None:
+        if tr is not None:
+            if out is not None:
+                tr.root.set(status=out[0])
+            _otrace.finish(tr)
+
+    def _attribute(self, out, info: dict, tr):
+        """Post-process a routed answer: merge the attribution the
+        route collected — answering worker identity + both attempt
+        span IDs (primary + hedge), the ISSUE 15 hedge-attribution
+        contract — into a successful JSON envelope, and echo the trace
+        context as a ``traceparent`` response header."""
+        status, headers, data = out
+        if status == 200 and info.get("worker"):
+            try:
+                obj = json.loads(data)
+            except ValueError:
+                obj = None
+            if isinstance(obj, dict):
+                route_info = {"worker": info["worker"]}
+                for k in ("worker_identity", "primary_span_id",
+                          "hedge_span_id", "answered_by_hedge",
+                          "hedge_won"):
+                    if info.get(k) is not None:
+                        route_info[k] = info[k]
+                if tr is not None:
+                    route_info["trace_id"] = tr.trace_id
+                obj["route"] = route_info
+                data = json.dumps(obj, default=str).encode()
+        if tr is not None:
+            tp = _otrace.inject(tr.trace_id, tr.root.sid())
+            if tp:
+                headers = {**headers, _otrace.TRACEPARENT: tp}
+        return status, headers, data
+
+    def _routed(self, name: str, fn, info: dict | None = None,
+                **attrs) -> None:
+        """Run one route() call under a request trace and relay its
+        (attributed) answer."""
+        tr, _ = self._trace_begin(name, **attrs)
+        out = None
+        try:
+            out = fn()
+        finally:
+            self._finish_trace(tr, out)
+        self._relay(self._attribute(out, info or {}, tr))
+
     def do_GET(self):
         route = self._route()
         r = self.router
@@ -651,21 +874,71 @@ class RouterHandler(BaseHTTPRequestHandler):
                 "workers": r.tracker.urls(),
                 "proxies": ["/submit", "/evaluate", "/warmup",
                             "/clusters/*"],
+                "debug": ["/debug/traces", "/debug/traces/<id>"],
             })
         elif route == "/clusters":
             r._count("requests_total", "clusters_get")
             self._merge_cluster_listing()
+        elif route == "/debug/traces":
+            r._count("requests_total", "debug_traces")
+            self._send_json(200, {"trace_ids": _otrace.RECENT.ids()})
+        elif route.startswith("/debug/traces/"):
+            r._count("requests_total", "debug_traces")
+            self._merged_trace(route[len("/debug/traces/"):])
         elif route.startswith("/clusters/"):
             cid = route[len("/clusters/"):].split("/", 1)[0]
             r._count("requests_total", "clusters_get")
             r._count("sticky_total")
-            self._relay(r.route("GET", self.path, None, sticky=cid,
-                                timeout=r.connect_timeout_s * 6))
+            self._routed(
+                "route",
+                lambda: r.route("GET", self.path, None, sticky=cid,
+                                timeout=r.connect_timeout_s * 6),
+                route="clusters_get", cluster=cid,
+            )
         else:
             self._send_json(404, {
                 "error": f"no such router endpoint: {self.path}; "
                          "worker debug surfaces are per-worker "
                          "(see /healthz fleet.workers)",
+            })
+
+    def _merged_trace(self, trace_id: str) -> None:
+        """GET /debug/traces/<id> — the cross-process causal join
+        (docs/OBSERVABILITY.md "Distributed traces"): the router's own
+        route trace plus every live worker's /debug/solves/<id> tree
+        for the same ID, unioned under the router's root
+        (obs.causal.merge_fleet_trace). ``?format=chrome`` exports the
+        merged tree as ONE Perfetto file with per-process track
+        groups."""
+        r = self.router
+        own = _otrace.RECENT.get(trace_id)
+        remotes, errors = _ocausal.collect_remote(
+            r.tracker.live(), trace_id,
+            timeout_s=r.connect_timeout_s * 6,
+        )
+        if own is None and not remotes:
+            self._send_json(404, {
+                "error": f"no trace {trace_id!r} on the router or any "
+                         "live worker (rings hold recent traces only; "
+                         "with KAO_TRACE_TAIL a fast-clean trace may "
+                         "have been head-sampled away)",
+                **({"errors": errors} if errors else {}),
+            })
+            return
+        merged = _ocausal.merge_fleet_trace(trace_id, own, remotes)
+        if errors:
+            merged["errors"] = errors
+        fmt = (urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query,
+        ).get("format") or ["json"])[0]
+        if fmt == "chrome":
+            self._send_json(200, _ochrome.to_chrome_fleet(merged))
+        elif fmt == "json":
+            self._send_json(200, merged)
+        else:
+            self._send_json(400, {
+                "error": f"unknown format {fmt!r}; want 'json' or "
+                         "'chrome'",
             })
 
     def _merge_cluster_listing(self) -> None:
@@ -731,11 +1004,20 @@ class RouterHandler(BaseHTTPRequestHandler):
                 isinstance(payload, dict)
                 and payload.get("deadline_s") is not None
             )
-            self._relay(r.route("POST", "/submit", body, key=key,
-                                hedge=hedge))
+            info: dict = {}
+            self._routed(
+                "route",
+                lambda: r.route("POST", "/submit", body, key=key,
+                                hedge=hedge, info=info),
+                info=info, route="submit",
+            )
         elif route == "/evaluate":
             r._count("requests_total", "evaluate")
-            self._relay(r.route("POST", "/evaluate", body))
+            self._routed(
+                "route",
+                lambda: r.route("POST", "/evaluate", body),
+                route="evaluate",
+            )
         elif route == "/warmup":
             r._count("requests_total", "warmup")
             try:
@@ -751,17 +1033,23 @@ class RouterHandler(BaseHTTPRequestHandler):
             r._count("sticky_total")
             # sticky + sequential: epoch fencing must see ONE writer
             # per cluster, so cluster commands never hedge in parallel
-            self._relay(r.route("POST", self.path, body, sticky=cid))
+            self._routed(
+                "route",
+                lambda: r.route("POST", self.path, body, sticky=cid),
+                route="clusters_post", cluster=cid,
+            )
         else:
             self._send_json(404,
                             {"error": f"no such endpoint: {self.path}"})
 
 
 def make_router_server(host: str, port: int, router: Router, *,
-                       verbose: bool = False) -> ThreadingHTTPServer:
+                       verbose: bool = False,
+                       trace: bool = True) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer((host, port), RouterHandler)
     srv.router = router
     srv.verbose = verbose
+    srv.trace = trace
     return srv
 
 
@@ -805,6 +1093,14 @@ def build_parser() -> argparse.ArgumentParser:
                     default=DEFAULT_HEDGE_BUDGET,
                     help="max concurrent hedged duplicates fleet-wide "
                          "(0 disables hedging)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable per-request causal traces (route "
+                         "decisions, attempts, hedges; responses then "
+                         "carry no traceparent and /debug/traces "
+                         "stays empty on the router). Tail retention "
+                         "on the router's ring is the same "
+                         "KAO_TRACE_TAIL env the workers honor "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--verbose", action="store_true",
                     help="access logs")
     return ap
@@ -832,7 +1128,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     tracker.start()
     srv = make_router_server(args.host, args.port, router,
-                             verbose=args.verbose)
+                             verbose=args.verbose,
+                             trace=not args.no_trace)
     _olog.log("router_listening", host=args.host,
               port=srv.server_address[1], workers=len(urls))
     try:

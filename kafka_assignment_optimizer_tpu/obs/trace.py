@@ -23,11 +23,18 @@ Propagation is ambient: ``begin()`` activates a trace on the current
 context, and every ``span()`` underneath — engine phases, mesh
 dispatch/compile, device transfers — attaches to it automatically, so
 the serving path can trace a whole request without threading a handle
-through every signature. ``finish()`` closes the trace, builds the
-solve report (span tree + per-phase seconds + optional annealing
-trajectory), registers it in the ``RECENT`` ring buffer (the
-``/debug/solves`` surface), and feeds the per-phase latency histograms
-rendered as ``kao_phase_seconds{phase=...}`` on ``/metrics``.
+through every signature. Across PROCESS boundaries propagation is
+explicit (docs/OBSERVABILITY.md "Distributed traces"): ``inject()``
+renders the active context as a W3C ``traceparent`` header, and
+``extract()`` + ``begin(remote_parent=...)`` adopt it on the far side,
+so a router-edge trace and the worker-side solve trace share one ID
+and re-join under ``GET /debug/traces/<id>``. ``finish()`` closes the
+trace, builds the solve report (span tree + per-phase seconds +
+optional annealing trajectory), registers it in the ``RECENT`` ring
+buffer (the ``/debug/solves`` surface) subject to the tail-retention
+policy (``KAO_TRACE_TAIL``, :class:`TailPolicy`), and feeds the
+per-phase latency histograms rendered as
+``kao_phase_seconds{phase=...}`` on ``/metrics``.
 """
 
 from __future__ import annotations
@@ -36,10 +43,11 @@ import contextlib
 import contextvars
 import json
 import os
+import re
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque, namedtuple
 
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
     "kao_current_span", default=None
@@ -62,6 +70,95 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def new_span_id() -> str:
+    return uuid.uuid4().hex[16:]
+
+
+# --------------------------------------------------------------------------
+# W3C traceparent codec (docs/OBSERVABILITY.md "Distributed traces")
+#
+# One trace must survive the process hop: the kao-router begins a trace
+# at its edge, ``inject()``s it into the upstream request headers, and
+# the worker ``extract()``s it so the solve's span tree carries the
+# router's trace ID (the /debug/traces join key). The wire format is
+# the W3C Trace Context ``traceparent`` header —
+# ``00-{trace-id:32hex}-{parent-span-id:16hex}-{flags:2hex}`` — so any
+# W3C-speaking proxy or client interoperates. Internal compact 16-hex
+# trace IDs are left-padded with zeros on the wire and stripped back on
+# extract; a foreign full-width 32-hex ID is adopted verbatim.
+# Malformed or unusable headers are tolerated: extract() returns None
+# (the request gets a fresh root; the remote link is dropped) and the
+# rejection is counted, never raised.
+# --------------------------------------------------------------------------
+
+TRACEPARENT = "traceparent"
+_TP_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+_HEX_RE = re.compile(r"^[0-9a-f]+$")
+_TID_PAD = "0" * 16
+
+# a remote causal context: the upstream trace ID to adopt and the
+# caller-side span that parents this process's root span
+RemoteContext = namedtuple("RemoteContext", ("trace_id", "span_id"))
+
+_PROP_LOCK = threading.Lock()
+# codec traffic counters (kao_trace_context_total{event=}):
+# extracted = remote contexts adopted, malformed = rejected headers
+# (new root, remote link dropped), injected = contexts propagated
+PROPAGATION = {"extracted": 0, "malformed": 0, "injected": 0}
+
+
+def _prop_count(event: str) -> None:
+    with _PROP_LOCK:
+        PROPAGATION[event] += 1
+
+
+def inject(trace_id: str | None = None,
+           span_id: str | None = None) -> str | None:
+    """The ``traceparent`` header value for the given context — or for
+    the ACTIVE one when called without arguments (the current span gets
+    a lazily-assigned span ID so the receiver can parent onto it).
+    Returns None when there is nothing propagable: no active trace, or
+    an ID the wire format cannot carry."""
+    if trace_id is None:
+        sp = _CURRENT.get()
+        if sp is None:
+            return None
+        trace_id = sp.trace.trace_id
+        span_id = sp.sid()
+    tid = str(trace_id).lower()
+    sid = str(span_id).lower() if span_id else new_span_id()
+    if len(tid) > 32 or not _HEX_RE.match(tid) \
+            or len(sid) > 16 or not _HEX_RE.match(sid):
+        return None
+    _prop_count("injected")
+    return f"00-{tid.rjust(32, '0')}-{sid.rjust(16, '0')}-01"
+
+
+def extract(value) -> RemoteContext | None:
+    """Parse a ``traceparent`` header into a :class:`RemoteContext`, or
+    None when absent/unusable (malformed syntax, all-zero IDs, the
+    reserved ``ff`` version) — the caller then starts a fresh root and
+    the remote link is dropped, never an error. A 32-hex ID carrying
+    our compact left-pad round-trips back to the 16-hex internal form;
+    a genuinely foreign full-width ID is adopted as-is."""
+    if not value or not isinstance(value, str):
+        return None
+    m = _TP_RE.match(value.strip().lower())
+    if m is None:
+        _prop_count("malformed")
+        return None
+    version, tid, sid, _flags = m.groups()
+    if version == "ff" or tid == "0" * 32 or sid == "0" * 16:
+        _prop_count("malformed")
+        return None
+    if tid.startswith(_TID_PAD):
+        tid = tid[len(_TID_PAD):]
+    _prop_count("extracted")
+    return RemoteContext(tid, sid)
+
+
 def _jsonable(v):
     """Coerce an attr value to something json.dumps handles (numpy
     scalars carry .item(); anything else falls back to str)."""
@@ -79,7 +176,8 @@ def _jsonable(v):
 class Span:
     """One timed pipeline step: name, start/end, attrs, children."""
 
-    __slots__ = ("name", "trace", "start", "end", "attrs", "children")
+    __slots__ = ("name", "trace", "start", "end", "attrs", "children",
+                 "span_id")
 
     def __init__(self, name: str, trace: "Trace", attrs: dict | None = None):
         self.name = name
@@ -88,6 +186,19 @@ class Span:
         self.end: float | None = None
         self.attrs = dict(attrs) if attrs else {}
         self.children: list[Span] = []
+        # lazily assigned (sid()): only spans that actually propagate
+        # across a process boundary pay for an ID — chunk/dispatch
+        # spans on the hot path never do
+        self.span_id: str | None = None
+
+    def sid(self) -> str:
+        """This span's ID, assigned on first use (under the trace lock:
+        a router attempt span can be read by the report serializer
+        while the attempt thread assigns it)."""
+        with self.trace._lock:
+            if self.span_id is None:
+                self.span_id = new_span_id()
+            return self.span_id
 
     def set(self, **attrs) -> None:
         # under the trace lock: a wrap()-ed worker span can still be
@@ -107,6 +218,7 @@ class Span:
             attrs = dict(self.attrs)
             children = list(self.children)
             end = self.end
+            span_id = self.span_id
         d: dict = {
             "name": self.name,
             "start_s": round(self.start - t0, 6),
@@ -116,6 +228,9 @@ class Span:
                 None if end is None else round(end - self.start, 6)
             ),
         }
+        if span_id is not None:
+            # only propagation-relevant spans carry one (see sid())
+            d["span_id"] = span_id
         if attrs:
             d["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
         if children:
@@ -295,22 +410,56 @@ def set_trajectory(**summary) -> None:
         tr.trajectory = {**(tr.trajectory or {}), **summary}
 
 
-def begin(trace=None, *, name: str = "solve", **attrs) -> Trace | None:
+def open_span(parent: Span | None, name: str, **attrs) -> Span | None:
+    """An explicitly-parented span for structured cross-thread work
+    (the router's attempt/hedge races): created and attached NOW, never
+    touching the ambient contextvar, so any thread may open children of
+    any parent it holds. Close with :func:`close_span`. None-in/None-out
+    so call sites stay unconditional."""
+    if parent is None:
+        return None
+    sp = Span(name, parent.trace, attrs)
+    parent.trace.attach(parent, sp)
+    return sp
+
+
+def close_span(sp: Span | None, **attrs) -> None:
+    """Stamp the end (and final attrs) of an :func:`open_span` span."""
+    if sp is None:
+        return
+    if attrs:
+        sp.set(**attrs)
+    sp.end = time.perf_counter()
+
+
+def begin(trace=None, *, name: str = "solve",
+          remote_parent: str | None = None, **attrs) -> Trace | None:
     """Start a trace when ``trace`` is truthy (``True`` → generated ID,
     a string → that ID) and activate it on the current context. Returns
     None — tracing disabled — otherwise. Nesting is legal: the token
-    restores the outer context at :func:`finish`."""
+    restores the outer context at :func:`finish`.
+
+    ``remote_parent`` records a propagated upstream context (the
+    ``traceparent`` parent span ID from :func:`extract`): the root span
+    becomes a remote-parented server span — ``parent_span_id`` /
+    ``span_kind: "server"`` in its attrs — which is how the fleet
+    trace merge re-attaches this process's tree under the exact router
+    attempt that caused it."""
     if not trace:
         return None
     tid = trace if isinstance(trace, str) else None
     tr = Trace(trace_id=tid, name=name, **attrs)
+    if remote_parent:
+        tr.root.attrs.setdefault("parent_span_id", str(remote_parent))
+        tr.root.attrs.setdefault("span_kind", "server")
     tr._token = _CURRENT.set(tr.root)
     return tr
 
 
 def finish(tr: Trace | None) -> dict | None:
     """Close ``tr``: deactivate it, build the solve report, register it
-    in the ring buffer, and feed the per-phase latency histograms.
+    in the ring buffer (subject to the tail-retention policy — see
+    :class:`TailPolicy`), and feed the per-phase latency histograms.
     Idempotent-ish on None for uniform call sites."""
     if tr is None:
         return None
@@ -324,9 +473,206 @@ def finish(tr: Trace | None) -> dict | None:
             pass
         tr._token = None
     rep = tr.report()
-    RECENT.put(rep)
+    decision = TAIL.decide(rep)
+    if TAIL.enabled:
+        rep["retention"] = decision
+    if decision != "dropped":
+        RECENT.put(rep)
+    # histograms see EVERY trace either way: retention bounds the ring,
+    # never the metrics
     _observe_tree(tr.root)
     return rep
+
+
+# --------------------------------------------------------------------------
+# tail-based trace retention (KAO_TRACE_TAIL — docs/OBSERVABILITY.md
+# "Distributed traces")
+# --------------------------------------------------------------------------
+
+TAIL_DECISIONS = ("full", "head", "dropped")
+# span names whose presence anywhere in the tree marks a trace
+# tail-worthy: degradation rungs (resilience.ladder), chaos marks, and
+# the engine's sweep→chain retry
+_TAIL_KEEP_SPANS = frozenset({"degrade", "chaos", "retry"})
+# root/span attrs that mark a trace tail-worthy regardless of latency
+_TAIL_KEEP_ATTRS = ("error", "hedged", "chaos")
+
+
+class TailPolicy:
+    """Decide, at finish(), whether a trace's full span tree is worth
+    ring residency. Disabled (the default) every trace is kept — the
+    PR 3 behavior. Enabled (``KAO_TRACE_TAIL=1`` or a spec, below),
+    full trees are kept only for traces that ended *interesting*:
+
+    - **slow** — wall clock at or above the rolling p-``quantile``
+      (default 0.99) of the last ``window`` traces of the same name
+      (the SLO-window p99 shape: per-class, recent);
+    - **degraded** — any ``degrade``/``retry`` mark in the tree
+      (resilience rung > 0), or an ``error`` attr anywhere;
+    - **chaos-touched** — a ``chaos`` mark or attr;
+    - **hedged** — the router stamped ``hedged`` on the root (the
+      duplicate-race traces a tail investigation always wants).
+
+    Everything else is *head-sampled*: kept iff a deterministic hash of
+    the trace ID lands in the 1-in-``head_every`` sample (the unbiased
+    baseline a dashboard compares the tail against), dropped from the
+    ring otherwise — so ring memory stays bounded at fleet request
+    rates while every trace an operator will actually chase is
+    retrievable in full. Dropped traces still feed every histogram.
+
+    Spec grammar: ``KAO_TRACE_TAIL=1`` (defaults) or comma-separated
+    ``head=N,window=N,quantile=F,min=N``. A typo fails loudly at
+    configure time (the chaos-spec discipline)."""
+
+    def __init__(self, enabled: bool = False, head_every: int = 16,
+                 window: int = 512, quantile: float = 0.99,
+                 min_samples: int = 64):
+        self.enabled = bool(enabled)
+        self.head_every = max(int(head_every), 1)
+        self.window = max(int(window), 8)
+        self.quantile = min(max(float(quantile), 0.0), 1.0)
+        self.min_samples = max(int(min_samples), 1)
+        self._lock = threading.Lock()
+        self._durations: dict[str, deque] = {}
+        self.counters = {d: 0 for d in TAIL_DECISIONS}
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "TailPolicy":
+        spec = (spec or "").strip().lower()
+        if not spec or spec in ("0", "off", "false"):
+            return cls(enabled=False)
+        kw: dict = {}
+        if spec not in ("1", "on", "true"):
+            keys = {"head": "head_every", "window": "window",
+                    "quantile": "quantile", "min": "min_samples"}
+            for part in spec.split(","):
+                k, sep, v = part.strip().partition("=")
+                if not sep or k not in keys:
+                    raise ValueError(
+                        f"bad KAO_TRACE_TAIL part {part!r}; want '1' "
+                        "or comma-separated head=N,window=N,"
+                        "quantile=F,min=N"
+                    )
+                try:
+                    kw[keys[k]] = (float(v) if k == "quantile"
+                                   else int(v))
+                except ValueError as e:
+                    raise ValueError(
+                        f"bad KAO_TRACE_TAIL value {part!r}: {e}"
+                    ) from e
+        return cls(enabled=True, **kw)
+
+    def configure(self, spec: str | None) -> None:
+        """Re-arm from a spec string (serve boot / tests); resets the
+        rolling windows but keeps the lifetime counters."""
+        fresh = TailPolicy.from_spec(spec)
+        with self._lock:
+            self.enabled = fresh.enabled
+            self.head_every = fresh.head_every
+            self.window = fresh.window
+            self.quantile = fresh.quantile
+            self.min_samples = fresh.min_samples
+            self._durations.clear()
+
+    @staticmethod
+    def _signals(report: dict) -> bool:
+        """True when the span tree carries a tail signal (degraded /
+        chaos-touched / hedged / errored) — an iterative walk over the
+        already-serialized report, once per finish."""
+        stack = [report.get("spans") or {}]
+        while stack:
+            sp = stack.pop()
+            if sp.get("name") in _TAIL_KEEP_SPANS:
+                return True
+            attrs = sp.get("attrs") or {}
+            for key in _TAIL_KEEP_ATTRS:
+                if attrs.get(key):
+                    return True
+            stack.extend(sp.get("spans") or ())
+        return False
+
+    def _slow(self, name: str, wall) -> bool:
+        """Feed the rolling per-name duration window; True when this
+        trace sits at/above the configured quantile of the RECENT
+        distribution (insufficient evidence during warmup reads as not
+        slow — head sampling covers the cold start)."""
+        if wall is None:
+            return False
+        with self._lock:
+            dq = self._durations.get(name)
+            if dq is None:
+                dq = self._durations[name] = deque(maxlen=self.window)
+            slow = False
+            if len(dq) >= self.min_samples:
+                ranked = sorted(dq)
+                k = min(int(len(ranked) * self.quantile),
+                        len(ranked) - 1)
+                slow = wall >= ranked[k]
+            dq.append(float(wall))
+        return slow
+
+    def decide(self, report: dict) -> str:
+        """``"full"`` | ``"head"`` | ``"dropped"`` for one finished
+        report. Deterministic: the head sample hashes the trace ID, so
+        a replayed seeded load makes identical decisions."""
+        if not self.enabled:
+            return "full"
+        name = report.get("name") or "solve"
+        slow = self._slow(name, report.get("wall_s"))
+        if slow or self._signals(report):
+            decision = "full"
+        else:
+            tid = str(report.get("trace_id") or "")
+            try:
+                h = int(tid[-8:], 16)
+            except ValueError:
+                h = sum(tid.encode())
+            decision = ("head" if h % self.head_every == 0
+                        else "dropped")
+        with self._lock:
+            self.counters[decision] += 1
+        return decision
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "head_every": self.head_every,
+                "window": self.window,
+                "quantile": self.quantile,
+                "min_samples": self.min_samples,
+                "decisions": dict(self.counters),
+            }
+
+
+TAIL = TailPolicy.from_spec(os.environ.get("KAO_TRACE_TAIL"))
+
+
+def trace_families() -> list:
+    """The ``kao_trace_*`` exposition families shared by every surface
+    that renders them (serve's /metrics and the kao-router — the
+    obs.expo contract, validated by tests/test_metrics_format.py)."""
+    snap = TAIL.snapshot()
+    with _PROP_LOCK:
+        prop = dict(PROPAGATION)
+    return [
+        ("kao_trace_tail_enabled", "gauge",
+         "tail-based trace retention armed (KAO_TRACE_TAIL; "
+         "docs/OBSERVABILITY.md)",
+         [(None, int(snap["enabled"]))]),
+        ("kao_trace_retained_total", "counter",
+         "finished traces by retention decision (full = slow/degraded/"
+         "chaos/hedged tail keep; head = deterministic baseline "
+         "sample; dropped = fast-clean, histograms only)",
+         [({"decision": d}, snap["decisions"][d])
+          for d in TAIL_DECISIONS]),
+        ("kao_trace_context_total", "counter",
+         "W3C traceparent codec traffic (extracted = remote contexts "
+         "adopted, malformed = rejected headers tolerated as new "
+         "roots, injected = contexts propagated downstream)",
+         [({"event": e}, prop[e])
+          for e in ("extracted", "malformed", "injected")]),
+    ]
 
 
 # --------------------------------------------------------------------------

@@ -165,10 +165,20 @@ class RolloutManager:
 
     def _observe(self, cmd: str, rec: RolloutRecord, wall_s: float,
                  **extra) -> str | None:
-        tid = _otrace.new_trace_id() if self.trace else None
+        # the "start" transition IS the rollout's root trace (its ID
+        # is the durable rec.trace_id the plan store carries); every
+        # later transition gets its own ID linked back via
+        # rollout_root, the same link the mid-rollout delta re-solve
+        # traces record (ISSUE 15, docs/ROLLOUT.md)
+        tid = None
+        if self.trace:
+            tid = (rec.trace_id if cmd == "start" and rec.trace_id
+                   else _otrace.new_trace_id())
         tr = _otrace.begin(tid, name="rollout", cluster=rec.cluster_id,
                            command=cmd)
         if tr is not None:
+            if rec.trace_id and tid != rec.trace_id:
+                tr.root.set(rollout_root=rec.trace_id)
             tr.root.set(status=rec.status, wave=rec.wave_index,
                         waves=len(rec.plan.waves),
                         applied=len(rec.applied),
@@ -191,6 +201,8 @@ class RolloutManager:
             # did by the time this record lands
             "quality": {"feasible": True, "certified": False,
                         "degraded": False},
+            **({"rollout_root": rec.trace_id}
+               if rec.trace_id and tid != rec.trace_id else {}),
             **extra,
         })
         _olog.log("rollout", cluster=rec.cluster_id, command=cmd,
@@ -207,6 +219,19 @@ class RolloutManager:
                 return None
             return self._view(rec)
 
+    def active_trace_root(self, cluster_id: str) -> str | None:
+        """The ACTIVE rollout's durable root trace ID for
+        ``cluster_id`` (None when no rollout owns the cluster) — what
+        serve's delta re-solve traces link to (ISSUE 15). Safe from
+        the solve path: the caller holds no manager locks there (the
+        watch registry runs solves outside its commit lock), and the
+        rollout→cluster lock order never reverses."""
+        with self._cluster_lock(cluster_id):
+            rec = self._load(cluster_id)
+            if rec is None or not rec.active:
+                return None
+            return rec.trace_id
+
     def _view(self, rec: RolloutRecord) -> dict:
         plan = rec.plan
         current = None
@@ -216,6 +241,7 @@ class RolloutManager:
         return {
             "cluster_id": rec.cluster_id,
             "status": rec.status,
+            "trace_id": rec.trace_id,
             "rollout_epoch": rec.rollout_epoch,
             "plan_epoch": rec.plan_epoch,
             "wave_index": rec.wave_index,
@@ -382,6 +408,10 @@ class RolloutManager:
                 base=current.to_dict(),
                 target=target.to_dict(),
                 generation=info["generation"],
+                # the durable root trace ID every transition and
+                # mid-rollout re-solve links to (ISSUE 15)
+                trace_id=(_otrace.new_trace_id() if self.trace
+                          else None),
             )
             self._persist(new)
         except BaseException:

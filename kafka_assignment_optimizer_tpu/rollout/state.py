@@ -87,6 +87,12 @@ class RolloutRecord:
     # re-bootstrap bumps it, and a rollout recorded against an older
     # generation refuses every further command (dead world)
     generation: int = 0
+    # the rollout's ROOT trace ID (docs/OBSERVABILITY.md "Distributed
+    # traces"): assigned at start, persisted with the record, and
+    # linked from every transition trace AND every mid-rollout delta
+    # re-solve trace (rollout_root attr) — the one ID a wave story
+    # joins under
+    trace_id: str | None = None
 
     @property
     def active(self) -> bool:
@@ -129,6 +135,7 @@ class RolloutRecord:
             "replans": self.replans,
             "applied": list(self.applied),
             "generation": self.generation,
+            "trace_id": self.trace_id,
         }
 
     @classmethod
@@ -150,6 +157,10 @@ class RolloutRecord:
             replans=int(d.get("replans", 0)),
             applied=[int(i) for i in d.get("applied", [])],
             generation=int(d.get("generation", 0)),
+            # absent on pre-ISSUE-15 records: the link simply reads
+            # unassigned, never an error
+            trace_id=(None if d.get("trace_id") is None
+                      else str(d["trace_id"])),
         )
 
 

@@ -106,7 +106,16 @@ Endpoints:
     annealing trajectory summary — lands in a bounded ring buffer,
     retrievable here until it ages out. ``--no-trace`` disables;
     ``--profile-dir`` adds ``jax.profiler`` captures for the first N
-    solves per bucket.
+    solves per bucket. Requests carrying a W3C ``traceparent`` header
+    ADOPT the propagated trace ID (remote-parented root; the header is
+    echoed back) — the cross-process join a ``kao-router`` resolves
+    via ``GET /debug/traces/<id>`` (docs/OBSERVABILITY.md
+    "Distributed traces"). Coalesced batch members each keep their OWN
+    trace ID: the member's report links to the shared batch report via
+    ``coalesced_into``, so every member's ID resolves here.
+    ``KAO_TRACE_TAIL`` arms tail-based retention: full span trees are
+    kept for slow/degraded/chaos-touched/hedged traces plus a
+    deterministic head sample; fast-clean traces feed histograms only.
 
 Concurrency: solves run on a bounded request queue drained by a small
 worker pool (``--workers`` / ``--queue-depth``) — overlapping submits
@@ -141,6 +150,7 @@ from .api import optimize
 from .models.cluster import Assignment, Topology, parse_broker_list
 from .obs import chrome as _ochrome
 from .obs import drift as _odrift
+from .obs import expo as _expo
 from .obs import flight as _oflight
 from .obs import log as _olog
 from .obs import sampler as _osampler
@@ -1049,6 +1059,12 @@ def render_metrics() -> str:
                     f'kao_slo_burn_rate{{class="{cls}",'
                     f'window="{win}"}} {w["burn_rate"]}'
                 )
+    # causal-tracing families (docs/OBSERVABILITY.md "Distributed
+    # traces"): tail-retention decisions + traceparent codec traffic,
+    # rendered through the SAME shared helpers the kao-router uses so
+    # the two surfaces cannot drift (obs.trace.trace_families)
+    for fam in _otrace.trace_families():
+        lines.extend(_expo.family_lines(*fam))
     # build identity (satellite, ISSUE 9): which code/runtime produced
     # every number above — the first thing to check when two scrapes
     # disagree
@@ -1252,9 +1268,15 @@ def _run_batch_job(entries: list[dict]) -> list:
     """Worker-pool body of one coalesced dispatch: one batched lane
     solve, per-request response dicts out (same shape as /submit's
     single-solve response) — or, per entry, the ApiError to deliver
-    instead. The batch runs under ONE trace — the first member's trace
-    ID — and every member's response echoes that shared ID, so any of
-    them retrieves the batch's solve report.
+    instead. The batch runs under ONE trace with its OWN fresh ID, and
+    every member keeps ITS OWN request trace ID (ISSUE 15 satellite —
+    the PR 3 shared-first-member-ID scheme aliased every coalesced
+    client, and a router-propagated trace, onto one trace): each
+    member's envelope echoes its own ``trace_id`` plus
+    ``coalesced_into`` (the batch ID), and a per-member stub report
+    carrying the same link lands in the ring, so
+    ``GET /debug/solves/<id>`` resolves for every member and the
+    router join never collides two clients.
 
     Deadline contract (docs/RESILIENCE.md): each entry carries its
     request Budget. The queue wait between _flush and here is bounded
@@ -1282,9 +1304,10 @@ def _run_batch_job(entries: list[dict]) -> list:
     if not live:
         return results
     entries = [entries[i] for i in live]
-    trace_id = next(
-        (e.get("trace_id") for e in entries if e.get("trace_id")), None
-    )
+    member_tids = [e.get("trace_id") for e in entries]
+    # the batch trace gets a FRESH ID (never a member's): member IDs
+    # stay unique per client and link here via coalesced_into
+    trace_id = _otrace.new_trace_id() if any(member_tids) else None
     opts = dict(entries[0]["options"])
     budgets = [e["options"].get("time_limit_s") for e in entries
                if e["options"].get("time_limit_s") is not None]
@@ -1296,6 +1319,9 @@ def _run_batch_job(entries: list[dict]) -> list:
         opts["time_limit_s"] = min(budgets)
     tr = _otrace.begin(trace_id, name="request_batch",
                        lanes=len(entries))
+    if tr is not None:
+        tr.root.set(coalesced_members=",".join(
+            t for t in member_tids if t))
     try:
         outs = optimize_batch(
             [e["current"] for e in entries],
@@ -1316,20 +1342,63 @@ def _run_batch_job(entries: list[dict]) -> list:
         _METRICS["solve_seconds_total"] += dt
         _METRICS["last_solve_seconds"] = dt
     reps = [o.report() for o in outs]
+    batch_rep = None
     if tr is not None:
         tr.root.set(wall_s=round(dt, 4),
                     lanes_feasible=sum(
                         1 for r in reps if r.get("feasible")))
-        _otrace.finish(tr)
+        batch_rep = _otrace.finish(tr)
     _olog.log("solve_batch", trace_id=trace_id, lanes=len(outs),
               wall_s=round(dt, 4))
     for j, (o, rep) in enumerate(zip(outs, reps)):
+        member_tid = member_tids[j]
+        # member stubs follow the BATCH's tail-retention decision: a
+        # dropped batch registers no stubs (a dangling coalesced_into
+        # would 404, and untail-sampled stubs would flood the ring the
+        # policy exists to bound)
+        if member_tid and batch_rep is not None \
+                and batch_rep.get("retention") != "dropped":
+            _register_member_trace(member_tid, batch_rep,
+                                   entries[j].get("remote_parent"),
+                                   lane=j)
         results[live[j]] = {
             "assignment": o.assignment.to_dict(),
             "report": rep,
-            **({"trace_id": trace_id} if trace_id else {}),
+            **({"trace_id": member_tid,
+                "coalesced_into": trace_id} if member_tid else {}),
         }
     return results
+
+
+def _register_member_trace(member_tid: str, batch_rep: dict,
+                           remote_parent: str | None,
+                           lane: int) -> None:
+    """One coalesced member's OWN ring entry: a stub report under the
+    member's trace ID whose root span links to the shared batch report
+    (``coalesced_into``) and — when the request carried a propagated
+    traceparent — records its remote parent span, so the router-side
+    merge still attaches this member to the exact attempt that sent
+    it. Registered directly (not via a Trace): the real span tree
+    lives in the batch report one hop away."""
+    attrs: dict = {"coalesced_into": batch_rep["trace_id"],
+                   "lane": lane}
+    if remote_parent:
+        attrs["parent_span_id"] = str(remote_parent)
+        attrs["span_kind"] = "server"
+    _otrace.RECENT.put({
+        "trace_id": member_tid,
+        "name": "request",
+        "started_unix": batch_rep.get("started_unix"),
+        "wall_s": batch_rep.get("wall_s"),
+        "coalesced_into": batch_rep["trace_id"],
+        "phases": batch_rep.get("phases") or {},
+        "spans": {
+            "name": "request",
+            "start_s": 0.0,
+            "wall_s": batch_rep.get("wall_s"),
+            "attrs": attrs,
+        },
+    })
 
 
 _COALESCER = _Coalescer()
@@ -1384,10 +1453,16 @@ def handle_submit(
     *,
     lock_wait_s: float = DEFAULT_LOCK_WAIT_S,
     max_solve_s: float | None = DEFAULT_MAX_SOLVE_S,
+    trace_ctx=None,
 ) -> dict:
     """Pure request handler (also the unit-test surface): payload dict in,
     response dict out; raises ApiError with an HTTP status on bad input,
-    and 503 when the solver is saturated past ``lock_wait_s``."""
+    and 503 when the solver is saturated past ``lock_wait_s``.
+
+    ``trace_ctx`` (an ``obs.trace.RemoteContext`` from a validated
+    ``traceparent`` header) makes the solve ADOPT the propagated trace
+    ID and record the remote parent span, so a router-edge trace and
+    this worker's solve phases share one retrievable tree."""
     if not isinstance(payload, dict):
         raise ApiError(400, "request body must be a JSON object")
     if "assignment" not in payload:
@@ -1467,10 +1542,18 @@ def handle_submit(
         )
     lock_wait_s = budget.cap(lock_wait_s)
 
-    # request-scoped trace ID: generated here, propagated into the
-    # solve (ambient obs.trace), echoed in the response envelope, and
+    # request-scoped trace ID: adopted from a propagated traceparent
+    # context when one arrived (the router join), generated fresh
+    # otherwise; threaded into the solve (ambient obs.trace), echoed
+    # in the response envelope, stamped into the flight record, and
     # retrievable via GET /debug/solves/<trace_id>
-    trace_id = _otrace.new_trace_id() if OBS["trace"] else None
+    trace_id, remote_parent = None, None
+    if OBS["trace"]:
+        if trace_ctx is not None:
+            trace_id, remote_parent = trace_ctx.trace_id, \
+                trace_ctx.span_id
+        else:
+            trace_id = _otrace.new_trace_id()
     try:
         # coalescing path: explicit TPU solves whose knobs the batched
         # lane solver understands may ride a shared dispatch. The
@@ -1540,6 +1623,7 @@ def handle_submit(
                     "instance": inst,
                     "seed": options.get("seed", 0),
                     "trace_id": trace_id,
+                    "remote_parent": remote_parent,
                     "budget": budget,
                     "options": {k: v for k, v in options.items()
                                 if k != "seed"},
@@ -1607,7 +1691,8 @@ def handle_submit(
                 prof = _profile_dir_for(bucket_key, trace_id)
                 if prof:
                     kw["profile_dir"] = prof
-            tr = _otrace.begin(trace_id, name="request", solver=solver)
+            tr = _otrace.begin(trace_id, name="request", solver=solver,
+                               remote_parent=remote_parent)
             try:
                 res = optimize(
                     current, brokers, topology, target_rf=rf,
@@ -1761,6 +1846,18 @@ def _watch_solve_fn(state, prev_plan, budget) -> tuple[dict, dict]:
                 kw["profile_dir"] = prof
         tr = _otrace.begin(trace_id, name="watch_event",
                            cluster=state.cluster_id, epoch=state.epoch)
+        if tr is not None:
+            # mid-rollout re-solve linkage (ISSUE 15, docs/ROLLOUT.md):
+            # while a rollout owns this cluster's ground truth, the
+            # delta re-solve trace links to the rollout's durable root
+            # trace ID (persisted in the plan-store record), so the
+            # whole wave story — start, re-solve, replan — joins under
+            # one ID
+            rmgr = ROLLOUT.get("manager")
+            if rmgr is not None:
+                root_tid = rmgr.active_trace_root(state.cluster_id)
+                if root_tid:
+                    tr.root.set(rollout_root=root_tid)
         try:
             # flight-record tagging on THIS worker thread: the watch
             # manager's own context() does not cross the queue hop, so
@@ -2037,6 +2134,9 @@ def handle_healthz() -> dict:
             "solve_reports_held": len(_otrace.RECENT.ids()),
             "report_ring_capacity": _otrace.RECENT.capacity,
             "report_ring": _otrace.RECENT.stats(),
+            # tail-based retention state (KAO_TRACE_TAIL — decisions
+            # so far + the active policy knobs)
+            "trace_tail": _otrace.TAIL.snapshot(),
             "profile_dir": OBS["profile_dir"],
             "flight": _oflight.snapshot(),
             # live-stream fan-out + fleet identity (/debug/stream,
@@ -2814,9 +2914,23 @@ class Handler(BaseHTTPRequestHandler):
                 )
                 self._send(status, body)
                 return
-            self._send(200, handle_submit(
-                payload, lock_wait_s=lock_wait_s, max_solve_s=max_solve_s,
-            ))
+            # cross-process causal tracing (docs/OBSERVABILITY.md
+            # "Distributed traces"): a valid W3C traceparent header
+            # makes this solve ADOPT the propagated trace ID (the
+            # kao-router join); malformed headers are tolerated as a
+            # fresh root. The accepted context is echoed back.
+            tp_ctx = _otrace.extract(
+                self.headers.get(_otrace.TRACEPARENT))
+            out = handle_submit(
+                payload, lock_wait_s=lock_wait_s,
+                max_solve_s=max_solve_s, trace_ctx=tp_ctx,
+            )
+            echo = None
+            if tp_ctx is not None and out.get("trace_id"):
+                tp = _otrace.inject(out["trace_id"], tp_ctx.span_id)
+                if tp:
+                    echo = {_otrace.TRACEPARENT: tp}
+            self._send(200, out, headers=echo)
         except ApiError as e:
             if e.status != 503:
                 _count(errors_total=1)

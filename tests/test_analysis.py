@@ -363,6 +363,70 @@ def test_kao110_lane_config_capture_in_factories():
     assert _rules(_lint(sup)) == []
 
 
+# ---------------------------------------------------------------- KAO111
+
+POS_111_REQUEST = """
+    import http.client
+
+    def proxy(url, body):
+        conn = http.client.HTTPConnection(url)
+        conn.request("POST", "/submit", body=body)
+        return conn.getresponse().read()
+"""
+
+POS_111_URLOPEN = """
+    import urllib.request
+
+    def fanout(url):
+        with urllib.request.urlopen(url + "/clusters") as r:
+            return r.read()
+"""
+
+NEG_111_INJECTED = """
+    import http.client
+    from .obs import trace as _otrace
+
+    def proxy(url, body):
+        hdrs = {"traceparent": _otrace.inject()}
+        conn = http.client.HTTPConnection(url)
+        conn.request("POST", "/submit", body=body, headers=hdrs)
+        return conn.getresponse().read()
+"""
+
+NEG_111_HEADER_PARAM = """
+    import http.client
+
+    def proxy_once(url, body, headers=None):
+        # propagation is the CALLER's contract: headers thread through
+        conn = http.client.HTTPConnection(url)
+        conn.request("POST", "/submit", body=body,
+                     headers=headers or {})
+        return conn.getresponse().read()
+"""
+
+
+def test_kao111_uninjected_http_in_serving_tier():
+    # the rule is scoped to the serving tier (serve.py, fleet/)
+    assert "KAO111" in _rules(_lint(POS_111_REQUEST,
+                                    rel="fleet/router.py"))
+    assert "KAO111" in _rules(_lint(POS_111_URLOPEN, rel="serve.py"))
+    assert "KAO111" not in _rules(_lint(NEG_111_INJECTED,
+                                        rel="fleet/router.py"))
+    assert "KAO111" not in _rules(_lint(NEG_111_HEADER_PARAM,
+                                        rel="fleet/router.py"))
+    # out of scope: an engine module making an HTTP call is not this
+    # rule's business
+    assert "KAO111" not in _rules(_lint(POS_111_REQUEST,
+                                        rel="solvers/tpu/engine.py"))
+    # suppressible with justification (the health-poll dogfood shape)
+    sup = POS_111_URLOPEN.replace(
+        'with urllib.request.urlopen(url + "/clusters") as r:',
+        "# kao: disable=KAO111 -- read-only poll, no active request\n"
+        '        with urllib.request.urlopen(url + "/clusters") as r:',
+    )
+    assert _rules(_lint(sup, rel="serve.py")) == []
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

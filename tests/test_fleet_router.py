@@ -593,3 +593,47 @@ def test_hedge_fires_after_window_and_secondary_wins():
         srv.server_close()
         slow.kill()
         fast.kill()
+
+
+def test_hedge_attribution_survives_unscheduled_hedge_thread():
+    """Both attempt span IDs reach the envelope even when the primary
+    retires before the hedge thread ever runs its attempt: span IDs
+    are stamped at launch() time, before Thread.start(), so the
+    winner's merge can never observe a half-born race."""
+    slow = _FakeWorker(warm=[DEMO_KEY], solve_s=0.4)
+    fast = _FakeWorker()
+    router, srv, url = _make_router([slow, fast], hedge_ms=50.0,
+                                    hedge_budget=2)
+    gate = threading.Event()
+    real = type(router)._attempt_one
+
+    def gated(*a, **kw):
+        if kw.get("hedge"):
+            # deterministically reproduce the race: the hedge thread
+            # is launched but its attempt body does not run until the
+            # primary has already won and merged
+            gate.wait(5.0)
+        return real(router, *a, **kw)
+
+    router._attempt_one = gated
+    try:
+        status, body = _post(url, "/submit",
+                             {**DEMO_PAYLOAD, "deadline_s": 30})
+        gate.set()
+        assert status == 200
+        route = body["route"]
+        assert route["worker"] == slow.url  # the gated hedge lost
+        assert route["answered_by_hedge"] is False
+        assert route["hedge_won"] is False
+        # the regression: pre-fix the hedge span ID was written inside
+        # the hedge thread's attempt, so it was absent here
+        assert route.get("primary_span_id")
+        assert route.get("hedge_span_id")
+        assert route["primary_span_id"] != route["hedge_span_id"]
+        assert router.snapshot()["counters"]["hedges_total"] == 1
+    finally:
+        gate.set()
+        srv.shutdown()
+        srv.server_close()
+        slow.kill()
+        fast.kill()

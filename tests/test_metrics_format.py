@@ -283,6 +283,51 @@ def test_router_families_are_valid_exposition():
             (("worker", "http://w1:1"),)) in samples
 
 
+def test_trace_families_on_both_surfaces():
+    """ISSUE 15 satellite: the ``kao_trace_*`` families (tail-based
+    retention decisions + W3C traceparent codec traffic) render
+    through the shared ``obs.trace.trace_families`` helper on BOTH
+    exposition surfaces — serve's ``/metrics`` and the kao-router's —
+    with HELP/TYPE pairs, every decision/event label pre-declared at
+    zero, and values that track the counters."""
+    from kafka_assignment_optimizer_tpu.fleet.health import FleetTracker
+    from kafka_assignment_optimizer_tpu.fleet.router import (
+        Router,
+        render_router_metrics,
+    )
+
+    # move the codec counters so the values are provably live
+    otrace.extract("garbage-header")               # malformed += 1
+    ctx = otrace.extract(otrace.inject("ab" * 8))  # injected/extracted
+    assert ctx is not None
+    malformed = otrace.PROPAGATION["malformed"]
+
+    tracker = FleetTracker(["http://w1:1"], interval_s=3600,
+                           fetch=lambda u: {"cache": {}})
+    tracker.poll_once()
+    for text in (srv.render_metrics(),
+                 render_router_metrics(Router(tracker))):
+        samples = validate_prometheus(text)
+        by_key = {
+            (n, lab): True for n, lab in samples
+        }
+        names = {n for n, _ in samples}
+        assert "kao_trace_tail_enabled" in names
+        assert "kao_router_trace_reports" in names \
+            or "kao_phase_seconds_count" in names  # surface-specific
+        for decision in ("full", "head", "dropped"):
+            assert ("kao_trace_retained_total",
+                    (("decision", decision),)) in by_key, decision
+        for event in ("extracted", "malformed", "injected"):
+            assert ("kao_trace_context_total",
+                    (("event", event),)) in by_key, event
+        # the rendered malformed count matches the live counter
+        row = re.search(
+            r'^kao_trace_context_total\{event="malformed"\} (\d+)$',
+            text, re.M)
+        assert row and int(row.group(1)) >= malformed
+
+
 def test_validator_rejects_malformed_exposition():
     import pytest
 

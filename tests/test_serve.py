@@ -667,9 +667,13 @@ def test_submit_no_trace_when_disabled(monkeypatch):
     assert "solver_trace_id" not in out["report"]
 
 
-def test_coalesced_batch_shares_one_trace(monkeypatch):
-    """Every member of a coalesced dispatch echoes the SAME trace_id,
-    and that ID retrieves the batch's solve report."""
+def test_coalesced_batch_members_keep_own_traces(monkeypatch):
+    """ISSUE 15 satellite (the PR 3 shared-ID fix): every member of a
+    coalesced dispatch echoes its OWN trace_id, each ID resolves in
+    the report ring as a stub linking to the shared batch report via
+    coalesced_into, and the batch report (its own fresh ID) carries
+    the real span tree — so a router-propagated trace never aliases
+    two clients onto one trace."""
     from kafka_assignment_optimizer_tpu import serve as srv_mod
 
     monkeypatch.setattr(srv_mod._Coalescer, "should_bypass",
@@ -690,13 +694,27 @@ def test_coalesced_batch_shares_one_trace(monkeypatch):
         t.join(timeout=120)
         assert not t.is_alive()
     tids = {out.get("trace_id") for out in results}
-    assert len(tids) == 1 and None not in tids
+    assert len(tids) == 2 and None not in tids, tids
+    batch_ids = {out.get("coalesced_into") for out in results}
+    assert len(batch_ids) == 1 and None not in batch_ids
+    batch_id = batch_ids.pop()
+    assert batch_id not in tids  # the batch trace has its OWN id
     from kafka_assignment_optimizer_tpu.obs import trace as otrace
 
-    rep = otrace.RECENT.get(tids.pop())
+    # the batch report carries the real span tree + the member links
+    rep = otrace.RECENT.get(batch_id)
     assert rep is not None and rep["name"] == "request_batch"
     names = set(_span_names(rep["spans"]))
     assert {"seed", "ladder", "verify"} <= names, names
+    members = set(
+        rep["spans"]["attrs"]["coalesced_members"].split(","))
+    assert members == tids
+    # every member's OWN id resolves to a stub linking back
+    for out in results:
+        stub = otrace.RECENT.get(out["trace_id"])
+        assert stub is not None, out["trace_id"]
+        assert stub["coalesced_into"] == batch_id
+        assert stub["spans"]["attrs"]["coalesced_into"] == batch_id
 
 
 def test_healthz_observability_section(server_url):

@@ -1,0 +1,550 @@
+"""Cross-process causal tracing (ISSUE 15, docs/OBSERVABILITY.md
+"Distributed traces").
+
+Four layers:
+
+- the W3C ``traceparent`` codec: compact-ID round-trip, foreign-ID
+  adoption, malformed headers tolerated as fresh roots (remote link
+  dropped, counters moved, never an exception);
+- remote-parented roots + serve-side adoption: a ``handle_submit``
+  carrying a propagated context runs the solve under the ROUTER's
+  trace ID and records the parent span; absent the header, the
+  ambient trace is byte-for-byte the PR 3 behavior;
+- the tail-retention policy (``KAO_TRACE_TAIL``): slow / degraded /
+  chaos-touched / hedged traces keep their full trees, fast-clean
+  traces survive only the deterministic head sample — decisions
+  replayable under a seeded load;
+- the router+2-worker join (the acceptance shape): a hedged request
+  through a real ``Router`` over two scripted workers yields ONE
+  trace ID whose ``GET /debug/traces/<id>`` merges the router's
+  route/attempt/hedge spans with BOTH workers' solve trees (the hedge
+  duplicate included) and exports one multi-process Perfetto file.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kafka_assignment_optimizer_tpu.fleet import affinity
+from kafka_assignment_optimizer_tpu.fleet.health import FleetTracker
+from kafka_assignment_optimizer_tpu.fleet.router import (
+    Router,
+    make_router_server,
+)
+from kafka_assignment_optimizer_tpu.models.cluster import demo_assignment
+from kafka_assignment_optimizer_tpu.obs import causal as ocausal
+from kafka_assignment_optimizer_tpu.obs import chrome as ochrome
+from kafka_assignment_optimizer_tpu.obs import trace as otrace
+
+
+# --------------------------------------------------------------------------
+# codec
+# --------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_compact_id():
+    header = otrace.inject("abcd1234abcd1234", "00ff00ff00ff00ff")
+    assert header == (
+        "00-0000000000000000abcd1234abcd1234-00ff00ff00ff00ff-01"
+    )
+    ctx = otrace.extract(header)
+    assert ctx == ("abcd1234abcd1234", "00ff00ff00ff00ff")
+
+
+def test_traceparent_foreign_full_width_id_adopted_verbatim():
+    foreign = "00-" + "a1" * 16 + "-" + "b2" * 8 + "-01"
+    ctx = otrace.extract(foreign)
+    assert ctx is not None
+    assert ctx.trace_id == "a1" * 16       # full 32-hex, no stripping
+    assert ctx.span_id == "b2" * 8
+    # and it re-injects as itself
+    assert otrace.inject(ctx.trace_id, ctx.span_id) == foreign
+
+
+def test_traceparent_malformed_tolerated_as_new_root():
+    before = dict(otrace.PROPAGATION)
+    bad = [
+        "garbage",
+        "00-zz" + "0" * 30 + "-" + "b" * 16 + "-01",   # non-hex
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",     # reserved ver
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",     # zero trace
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # zero span
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",     # short
+    ]
+    for header in bad:
+        assert otrace.extract(header) is None, header
+    # absent headers are not "malformed" — just absent
+    assert otrace.extract(None) is None
+    assert otrace.extract("") is None
+    after = dict(otrace.PROPAGATION)
+    assert after["malformed"] - before["malformed"] == len(bad)
+    assert after["extracted"] == before["extracted"]
+
+
+def test_inject_reads_ambient_context_and_assigns_span_id():
+    assert otrace.inject() is None  # no active trace, nothing to send
+    tr = otrace.begin(True, name="request")
+    try:
+        with otrace.span("attempt") as sp:
+            header = otrace.inject()
+            assert header is not None
+            ctx = otrace.extract(header)
+            assert ctx.trace_id == tr.trace_id
+            # the ambient span got a lazily-assigned ID, and the
+            # header carries exactly it
+            assert ctx.span_id == sp.span_id
+    finally:
+        otrace.finish(tr)
+
+
+def test_begin_remote_parent_marks_server_root():
+    tr = otrace.begin("cafe01", remote_parent="beef0000beef0000")
+    rep = otrace.finish(tr)
+    attrs = rep["spans"]["attrs"]
+    assert attrs["parent_span_id"] == "beef0000beef0000"
+    assert attrs["span_kind"] == "server"
+    # without a remote parent the root is untouched (ambient behavior
+    # unchanged when no header arrives)
+    rep2 = otrace.finish(otrace.begin("cafe02", name="request"))
+    assert "parent_span_id" not in (rep2["spans"].get("attrs") or {})
+
+
+# --------------------------------------------------------------------------
+# serve-side adoption
+# --------------------------------------------------------------------------
+
+
+def _milp_payload():
+    return {
+        "assignment": demo_assignment().to_dict(),
+        "brokers": "0-18",
+        "solver": "milp",
+    }
+
+
+def test_handle_submit_adopts_propagated_context():
+    from kafka_assignment_optimizer_tpu.obs import flight as oflight
+    from kafka_assignment_optimizer_tpu.serve import handle_submit
+
+    ctx = otrace.RemoteContext("feedfacefeedface", "1234abcd1234abcd")
+    out = handle_submit(_milp_payload(), trace_ctx=ctx)
+    # the response echoes the ROUTER's trace id, not a fresh one
+    assert out["trace_id"] == "feedfacefeedface"
+    rep = otrace.RECENT.get("feedfacefeedface")
+    assert rep is not None
+    attrs = rep["spans"]["attrs"]
+    assert attrs["parent_span_id"] == "1234abcd1234abcd"
+    assert attrs["span_kind"] == "server"
+    # the flight record is stamped with the same (propagated) trace id
+    assert any(
+        r.get("trace_id") == "feedfacefeedface"
+        for r in oflight.recent(64)
+    )
+
+
+def test_handle_submit_without_header_is_fresh_root():
+    from kafka_assignment_optimizer_tpu.serve import handle_submit
+
+    out = handle_submit(_milp_payload())
+    tid = out["trace_id"]
+    assert tid and tid != "feedfacefeedface"
+    rep = otrace.RECENT.get(tid)
+    assert "parent_span_id" not in (rep["spans"].get("attrs") or {})
+
+
+# --------------------------------------------------------------------------
+# tail-based retention
+# --------------------------------------------------------------------------
+
+
+def test_tail_spec_typo_fails_loudly():
+    with pytest.raises(ValueError):
+        otrace.TailPolicy.from_spec("head=4,windoow=9")
+    with pytest.raises(ValueError):
+        otrace.TailPolicy.from_spec("head=lots")
+
+
+def _fast_report(tid, name="request", wall=0.01):
+    return {"trace_id": tid, "name": name, "wall_s": wall,
+            "spans": {"name": name, "attrs": {}}}
+
+
+def test_tail_policy_deterministic_and_signal_complete():
+    policy = otrace.TailPolicy.from_spec(
+        "head=8,window=128,quantile=0.99,min=32")
+    import random
+
+    rng = random.Random(7)
+    tids = [format(rng.getrandbits(64), "016x") for _ in range(300)]
+    # warmup + steady load of fast-clean traces with rare 100x spikes
+    slow_ids, decisions = set(), {}
+    for i, tid in enumerate(tids):
+        wall = 0.01 + rng.random() * 0.002
+        if i > 100 and i % 50 == 0:
+            wall = 1.0
+            slow_ids.add(tid)
+        decisions[tid] = policy.decide(_fast_report(tid, wall=wall))
+    # every slow trace kept in full
+    assert all(decisions[t] == "full" for t in slow_ids)
+    # fast-clean traces: kept iff the deterministic hash says so
+    for tid, d in decisions.items():
+        if tid in slow_ids or d == "full":
+            continue
+        expect = ("head" if int(tid[-8:], 16) % 8 == 0 else "dropped")
+        assert d == expect, (tid, d)
+    # and a REPLAY of the same load makes identical decisions
+    replay = otrace.TailPolicy.from_spec(
+        "head=8,window=128,quantile=0.99,min=32")
+    rng = random.Random(7)
+    # consume the SAME id draws so the wall sequence replays exactly
+    assert [format(rng.getrandbits(64), "016x")
+            for _ in range(300)] == tids
+    for i, tid in enumerate(tids):
+        wall = 0.01 + rng.random() * 0.002
+        if i > 100 and i % 50 == 0:
+            wall = 1.0
+        assert replay.decide(_fast_report(tid, wall=wall)) == \
+            decisions[tid]
+    # degraded / chaos / hedged / errored traces are ALWAYS full
+    keep_shapes = [
+        {"spans": {"name": "request",
+                   "spans": [{"name": "degrade",
+                              "attrs": {"rung": "pallas_to_xla"}}]}},
+        {"spans": {"name": "request", "spans": [{"name": "chaos"}]}},
+        {"spans": {"name": "request", "attrs": {"hedged": True}}},
+        {"spans": {"name": "request",
+                   "spans": [{"name": "ladder",
+                              "attrs": {"error": "boom"}}]}},
+    ]
+    for shape in keep_shapes:
+        rep = {"trace_id": "00", "name": "request", "wall_s": 0.001,
+               **shape}
+        assert policy.decide(rep) == "full", shape
+
+
+def test_tail_retention_gates_the_report_ring(monkeypatch):
+    """finish() integration: with KAO_TRACE_TAIL armed, dropped
+    fast-clean traces never reach /debug/solves' ring, head/full ones
+    do (stamped with their decision), and the counters account for
+    every finish."""
+    tail = otrace.TAIL
+    snap_before = tail.snapshot()
+    tail.configure("head=4,window=64,quantile=0.95,min=8")
+    try:
+        seen = {"full": [], "head": [], "dropped": []}
+        for i in range(60):
+            tr = otrace.begin(True, name="tailprobe")
+            rep = otrace.finish(tr)
+            seen[rep["retention"]].append(rep["trace_id"])
+        # a degraded trace is always retained in full
+        tr = otrace.begin(True, name="tailprobe")
+        otrace.mark("degrade", rung="pallas_to_xla")
+        rep = otrace.finish(tr)
+        assert rep["retention"] == "full"
+        assert otrace.RECENT.get(rep["trace_id"]) is not None
+        assert seen["dropped"], "head=4 over 60 traces must drop some"
+        for tid in seen["dropped"]:
+            assert otrace.RECENT.get(tid) is None, tid
+        for tid in seen["head"]:
+            assert otrace.RECENT.get(tid) is not None, tid
+        counts = tail.snapshot()["decisions"]
+        for k in ("full", "head", "dropped"):
+            assert counts[k] >= len(seen[k])
+    finally:
+        tail.configure("off" if not snap_before["enabled"] else "1")
+
+
+# --------------------------------------------------------------------------
+# the router+2-worker join (the ISSUE 15 acceptance shape)
+# --------------------------------------------------------------------------
+
+
+class _TracingWorker:
+    """A scripted serve-worker stand-in that honors the causal-tracing
+    contract: it extracts the router's traceparent, answers /submit
+    with the adopted trace id, and serves the remote-parented span
+    tree back on GET /debug/solves/<id> — from its OWN store, so two
+    instances model two processes even in-process."""
+
+    def __init__(self, warm=(), solve_s=0.0):
+        self.warm = [list(k) for k in warm]
+        self.solve_s = solve_s
+        self.reports: dict = {}
+        self.traceparents: list = []
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    self._json(200, {
+                        "status": "ok",
+                        "cache": {"warm_buckets": fake.warm},
+                        "queue": {"depth": 0},
+                    })
+                elif self.path.startswith("/debug/solves/"):
+                    tid = self.path.rsplit("/", 1)[1].split("?")[0]
+                    with fake._lock:
+                        rep = fake.reports.get(tid)
+                    if rep is None:
+                        self._json(404, {"error": "no such trace"})
+                    else:
+                        self._json(200, rep)
+                else:
+                    self._json(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                tp = self.headers.get("traceparent")
+                ctx = otrace.extract(tp)
+                with fake._lock:
+                    fake.traceparents.append(tp)
+                if fake.solve_s:
+                    time.sleep(fake.solve_s)
+                wall = fake.solve_s or 0.01
+                if ctx is not None:
+                    with fake._lock:
+                        fake.reports[ctx.trace_id] = {
+                            "trace_id": ctx.trace_id,
+                            "name": "request",
+                            "started_unix": round(time.time(), 3),
+                            "wall_s": wall,
+                            "phases": {"ladder": wall / 2},
+                            "spans": {
+                                "name": "request",
+                                "start_s": 0.0,
+                                "wall_s": wall,
+                                "attrs": {
+                                    "parent_span_id": ctx.span_id,
+                                    "span_kind": "server",
+                                },
+                                "spans": [{
+                                    "name": "ladder",
+                                    "start_s": 0.001,
+                                    "wall_s": wall / 2,
+                                }],
+                            },
+                        }
+                self._json(200, {
+                    "worker": fake.url,
+                    "report": {"feasible": True},
+                    **({"trace_id": ctx.trace_id} if ctx else {}),
+                })
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def kill(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+DEMO_PAYLOAD = {
+    "assignment": demo_assignment().to_dict(),
+    "brokers": "0-18",
+    "topology": "even-odd",
+    "solver": "tpu",
+}
+DEMO_KEY = affinity.bucket_key_of(DEMO_PAYLOAD)
+
+
+def _router_over(workers, **kw):
+    tracker = FleetTracker([w.url for w in workers], interval_s=3600,
+                           timeout_s=2.0)
+    tracker.poll_once()
+    router = Router(tracker, lock_wait_s=kw.pop("lock_wait_s", 5.0),
+                    solve_timeout_s=10.0, connect_timeout_s=2.0, **kw)
+    srv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return router, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _get_json(url, timeout=15.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_router_hedged_request_yields_one_merged_multiprocess_trace():
+    """The ISSUE 15 acceptance shape, against the REAL Router: one
+    deadline-carrying /submit hedges onto a second worker, and
+    GET /debug/traces/<id> returns the router's route-decision spans
+    with BOTH workers' solve trees (primary + hedge duplicate)
+    attached under their exact attempt spans — plus a single
+    multi-process Perfetto export."""
+    slow = _TracingWorker(warm=[DEMO_KEY], solve_s=1.2)
+    fast = _TracingWorker()
+    router, srv, url = _router_over([slow, fast], hedge_ms=100.0)
+    try:
+        payload = dict(DEMO_PAYLOAD, deadline_s=30.0)
+        req = urllib.request.Request(
+            f"{url}/submit", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            echoed = resp.headers.get("traceparent")
+            body = json.loads(resp.read())
+        # hedge attribution in the envelope (ISSUE 15 satellite): the
+        # answering worker plus BOTH attempt span ids
+        route = body["route"]
+        assert route["worker"] == fast.url
+        assert route["hedge_won"] is True
+        assert route["answered_by_hedge"] is True
+        assert route["primary_span_id"] != route["hedge_span_id"]
+        tid = route["trace_id"]
+        assert tid
+        # the context was echoed AND propagated to both workers with
+        # the SAME trace id
+        assert otrace.extract(echoed).trace_id == tid
+        for w in (slow, fast):
+            assert len(w.traceparents) == 1
+            assert otrace.extract(w.traceparents[0]).trace_id == tid
+        # wait for the hedge LOSER to finish its solve and register
+        deadline = time.time() + 10
+        while time.time() < deadline and tid not in slow.reports:
+            time.sleep(0.05)
+        assert tid in slow.reports
+        status, merged = _get_json(f"{url}/debug/traces/{tid}")
+        assert status == 200
+        # one root (the router), two remote processes under it
+        assert merged["root"] is not None
+        assert merged["root"]["trace_id"] == tid
+        root_attrs = merged["root"]["spans"]["attrs"]
+        assert root_attrs.get("hedged") is True
+        assert root_attrs.get("hedge_won") is True
+        span_names = _names(merged["root"]["spans"])
+        assert "route_decision" in span_names
+        assert "attempt" in span_names
+        assert "hedge_launch" in span_names
+        # the echoed traceparent's parent span must EXIST in the
+        # stored tree (the root's ID is minted before the report
+        # snapshot, not lazily after)
+        assert merged["root"]["spans"]["span_id"] == \
+            otrace.extract(echoed).span_id
+        assert len(merged["processes"]) == 2
+        assert merged["processes_total"] == 3
+        attached = {p["attached_to"] for p in merged["processes"]}
+        assert attached == {route["primary_span_id"],
+                            route["hedge_span_id"]}
+        procs = {p["process"] for p in merged["processes"]}
+        assert procs == {slow.url, fast.url}
+        # the chrome export is ONE file with per-process track groups
+        status, chrome = _get_json(
+            f"{url}/debug/traces/{tid}?format=chrome")
+        assert status == 200
+        pids = {e["pid"] for e in chrome["traceEvents"]}
+        assert pids == {1, 2, 3}
+        names_by_pid = {
+            e["pid"]: e["args"]["name"]
+            for e in chrome["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert names_by_pid[1] == "kao router"
+        assert {names_by_pid[2], names_by_pid[3]} == \
+            {f"kao {slow.url}", f"kao {fast.url}"}
+        ts = [e["ts"] for e in chrome["traceEvents"]
+              if e.get("ph") != "M"]
+        assert ts == sorted(ts)
+        # unknown ids are a structured 404
+        status, _ = _get_json(f"{url}/debug/traces/nosuchtrace")
+        assert status == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        slow.kill()
+        fast.kill()
+
+
+def _names(span, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(span["name"])
+    for c in span.get("spans", []):
+        _names(c, acc)
+    return acc
+
+
+def test_router_adopts_client_traceparent_end_to_end():
+    """A client carrying its own traceparent owns the trace ID through
+    router AND worker: the router's root is remote-parented, and the
+    worker sees the same ID the client chose."""
+    w = _TracingWorker(warm=[DEMO_KEY])
+    router, srv, url = _router_over([w])
+    try:
+        client_tid = "c11e207f00d5c0de"
+        header = otrace.inject(client_tid, "abcdef0123456789")
+        req = urllib.request.Request(
+            f"{url}/submit", data=json.dumps(DEMO_PAYLOAD).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": header},
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            body = json.loads(resp.read())
+        assert body["route"]["trace_id"] == client_tid
+        assert otrace.extract(w.traceparents[0]).trace_id == client_tid
+        rep = otrace.RECENT.get(client_tid)
+        assert rep is not None
+        assert rep["spans"]["attrs"]["parent_span_id"] == \
+            "abcdef0123456789"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        w.kill()
+
+
+def test_merge_fleet_trace_degrades_without_router_half():
+    """The ring evicted the router's report: the worker trees still
+    union side by side (attached_to None), and the chrome export still
+    renders one pid per process."""
+    rep = {
+        "trace_id": "aa", "name": "request", "started_unix": 1.0,
+        "wall_s": 0.5,
+        "spans": {"name": "request", "start_s": 0.0, "wall_s": 0.5,
+                  "attrs": {"parent_span_id": "deadbeefdeadbeef"}},
+    }
+    merged = ocausal.merge_fleet_trace(
+        "aa", None, [{"process": "http://w1", "report": rep}])
+    assert merged["root"] is None
+    assert merged["processes"][0]["attached_to"] is None
+    assert merged["processes_total"] == 1
+    chrome = ochrome.to_chrome_fleet(merged)
+    assert {e["pid"] for e in chrome["traceEvents"]} == {1}
+
+
+def test_collect_remote_tolerates_dead_and_missing_workers():
+    rep = {"trace_id": "bb", "name": "request",
+           "spans": {"name": "request"}}
+
+    def fetch(url, tid):
+        if url == "http://dead":
+            raise OSError("connection refused")
+        if url == "http://misses":
+            return None
+        return rep
+
+    reports, errors = ocausal.collect_remote(
+        ["http://w1", "http://dead", "http://misses"], "bb",
+        fetch=fetch)
+    assert [r["process"] for r in reports] == ["http://w1"]
+    assert list(errors) == ["http://dead"]
