@@ -195,7 +195,7 @@ def _run_child(
     args: argparse.Namespace, name: str, env: dict, warmrun: bool,
     kernel: bool = False, batch_bench: bool = False,
     replay_day: bool = False, portfolio_bench: bool = False,
-    rollout_bench: bool = False,
+    rollout_bench: bool = False, decompose_bench: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -214,6 +214,8 @@ def _run_child(
         cmd.append("--portfolio-bench")
     if rollout_bench:
         cmd.append("--rollout-bench")
+    if decompose_bench:
+        cmd.append("--decompose-bench")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -927,6 +929,88 @@ def run_replay_day(smoke: bool, seed: int) -> dict:
     }
 
 
+def run_decompose_bench(smoke: bool, seed: int) -> dict:
+    """``--decompose-bench`` (docs/DECOMPOSE.md, ISSUE 16): the
+    decomposed map-reduce rung's evidence. One ultra-jumbo
+    AZ-structured decommission solved COLD through the decomposed path
+    (``ultra_jumbo_cold_s``), with the stitched plan re-verified here
+    against the flat instance's oracle (``stitched_feasible``) and the
+    certificate-or-bound-gap contract checked (``gap_ok``); plus a
+    decomposed-vs-flat A/B on the largest instance the flat path still
+    survives (``decompose_speedup``). Sub-problem count, iterations
+    and bound gap are stamped for obs/regress.py."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance,
+    )
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    limit_s = 120.0 if smoke else 900.0
+    big_sc = (
+        gen.ultra_jumbo(seed=seed, **gen.SMOKE_KWARGS["ultra_jumbo"])
+        if smoke else gen.ultra_jumbo_case(seed)
+    )
+    inst_big = build_instance(**big_sc.kwargs)
+    t0 = time.perf_counter()
+    res_big = solve_tpu(inst_big, seed=seed, decompose=True,
+                        time_limit_s=limit_s)
+    ultra_cold_s = time.perf_counter() - t0
+    d = res_big.stats.get("decompose") or {}
+    viol = int(sum(inst_big.violations(res_big.a).values()))
+    stitched_feasible = bool(
+        res_big.stats.get("engine") == "decomposed" and viol == 0
+    )
+    obj = int(res_big.objective or 0)
+    gap = int(d.get("bound_gap") or 0)
+    # the contract: a certificate, or a reported gap within 15% of the
+    # achieved objective (level-0 upper bounds are deliberately loose)
+    gap_ok = bool(d.get("certified")) or (
+        stitched_feasible and obj > 0 and gap <= 0.15 * obj
+    )
+
+    # flat-vs-decomposed A/B: smoke reuses the instance above (the
+    # decomposed wall already measured); full mode compares on a
+    # 50k-partition ultra-jumbo — jumbo scale, which flat survives
+    if smoke:
+        cmp_sc, dec_s, r_d = big_sc, ultra_cold_s, res_big
+        inst_cmp = inst_big
+    else:
+        cmp_sc = gen.ultra_jumbo(seed=seed, partitions=50_000)
+        inst_cmp = build_instance(**cmp_sc.kwargs)
+        t0 = time.perf_counter()
+        r_d = solve_tpu(inst_cmp, seed=seed, decompose=True,
+                        time_limit_s=limit_s)
+        dec_s = time.perf_counter() - t0
+    inst_flat = build_instance(**cmp_sc.kwargs)
+    t0 = time.perf_counter()
+    r_f = solve_tpu(inst_flat, seed=seed, decompose=False,
+                    time_limit_s=limit_s)
+    flat_s = time.perf_counter() - t0
+
+    return {
+        "ultra_parts": int(inst_big.num_parts),
+        "ultra_jumbo_cold_s": round(ultra_cold_s, 3),
+        "sub_problems": int(d.get("subproblems") or 0),
+        "iterations": int(d.get("iterations") or 0),
+        "boundary_parts": int(d.get("boundary_parts") or 0),
+        "bound_gap": gap,
+        "certified": bool(d.get("certified")),
+        "stitched_feasible": stitched_feasible,
+        "gap_ok": gap_ok,
+        "cmp_parts": int(inst_flat.num_parts),
+        "decomposed_wall_s": round(dec_s, 3),
+        "flat_wall_s": round(flat_s, 3),
+        "decompose_speedup": (
+            round(flat_s / dec_s, 3) if dec_s > 0 else 0.0
+        ),
+        "flat_feasible": bool(r_f.stats.get("feasible")),
+        "decomposed_feasible": bool(r_d.stats.get("feasible")),
+    }
+
+
 def run_rollout_bench(smoke: bool, seed: int) -> dict:
     """``--rollout-bench`` (docs/ROLLOUT.md, ISSUE 12): one full
     supervised rollout through the watch registry + rollout manager on
@@ -1400,6 +1484,10 @@ def child_main(args: argparse.Namespace) -> int:
         out = run_rollout_bench(args.smoke, args.seed)
         print("RESULT " + json.dumps(out))
         return 0
+    if args.decompose_bench:
+        out = run_decompose_bench(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
     out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
     if args.kernel:
         try:
@@ -1514,6 +1602,22 @@ def _compact_portfolio(rp: dict | None, err: str | None) -> dict:
     }
 
 
+def _compact_decompose(rd: dict | None, err: str | None) -> dict:
+    """The decompose block of the stdout line: the ultra-jumbo cold
+    wall, the decomposed-vs-flat speedup, sub-problem count, bound gap
+    and the deterministic quality keys (``stitched_feasible``,
+    ``gap_ok``) — the ISSUE 16 bench evidence, compare-gated by
+    obs/regress.py."""
+    if rd is None:
+        return {"error": (err or "failed")[:120]}
+    return {k: rd[k] for k in (
+        "ultra_parts", "ultra_jumbo_cold_s", "sub_problems",
+        "iterations", "bound_gap", "certified", "stitched_feasible",
+        "gap_ok", "cmp_parts", "decomposed_wall_s", "flat_wall_s",
+        "decompose_speedup",
+    )}
+
+
 def _compact_rollout(rr: dict | None, err: str | None) -> dict:
     """The rollout block of the stdout line: waves to completion, the
     independently-recomputed per-wave peaks vs caps, the mid-rollout
@@ -1587,6 +1691,7 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          batch_throughput: dict | None = None,
          replay_day: dict | None = None,
          portfolio_ab: dict | None = None,
+         decompose: dict | None = None,
          env_stamp: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
@@ -1687,6 +1792,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # portfolio A/B: worst-case quality at equal budget,
         # portfolio-on vs single-config (docs/PORTFOLIO.md)
         line["portfolio_ab"] = portfolio_ab
+    if decompose:
+        # decomposed map-reduce rung: ultra-jumbo cold wall,
+        # decomposed-vs-flat speedup, certificate-or-gap verdict
+        # (docs/DECOMPOSE.md)
+        line["decompose"] = decompose
     if "device_sampler" in head:
         # device-occupancy evidence for the headline run: duty cycle,
         # per-device memory, and the sampler's measured overhead
@@ -1766,6 +1876,18 @@ def main() -> int:
                          "graph, and the re-plan latency after a "
                          "mid-rollout broker loss; emitted as a "
                          "one-line rollout artifact wired into "
+                         "--compare regression keys (same exclusive "
+                         "convention as --replay-day)")
+    ap.add_argument("--decompose-bench", action="store_true",
+                    help="run ONLY the map-reduce decomposition "
+                         "scenario (docs/DECOMPOSE.md): the ultra-"
+                         "jumbo AZ-structured case solved through the "
+                         "decomposed rung — cold wall, sub-problem "
+                         "count, certificate-or-bound-gap verdict, "
+                         "oracle-checked stitched feasibility, and "
+                         "the decomposed-vs-flat speedup at a size "
+                         "both paths can solve — emitted as a "
+                         "one-line decompose artifact wired into "
                          "--compare regression keys (same exclusive "
                          "convention as --replay-day)")
     ap.add_argument("--fleet-bench", action="store_true",
@@ -1874,6 +1996,28 @@ def main() -> int:
         line = {"metric": "rollout_bench", "platform": platform,
                 "env": _env_stamp(platform, ndev, env),
                 "rollout": _compact_rollout(rr, er)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
+
+    if args.decompose_bench:
+        # standalone decomposition harness (the soak decomposition
+        # step's entry): one child, one dedicated stdout line — no
+        # scenario sweep
+        try:
+            env, platform, tpu_err, ndev = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "decompose_bench",
+                              "error": repr(e)[:300]}))
+            return 0
+        rd, ed = _run_child(args, "decompose_bench", env, warmrun=False,
+                            decompose_bench=True)
+        if rd is not None:
+            print("[bench] DECOMPOSE " + json.dumps(rd), file=sys.stderr)
+        line = {"metric": "decompose_bench", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
+                "decompose": _compact_decompose(rd, ed)}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
         print(json.dumps(line))
@@ -2059,6 +2203,18 @@ def main() -> int:
             print("[bench] PORTFOLIO " + json.dumps(rp), file=sys.stderr)
         portfolio_ab = _compact_portfolio(rp, ep)
 
+    decompose: dict | None = None
+    if extras:
+        # the map-reduce decomposition rung (PR-16 tentpole evidence):
+        # ultra-jumbo cold wall through the decomposed path, sub-problem
+        # count, certificate-or-gap verdict, and decomposed-vs-flat
+        # speedup, compacted for stdout
+        rd, ed = _run_child(args, "decompose_bench", env, warmrun=False,
+                            decompose_bench=True)
+        if rd is not None:
+            print("[bench] DECOMPOSE " + json.dumps(rd), file=sys.stderr)
+        decompose = _compact_decompose(rd, ed)
+
     batch_throughput: dict | None = None
     if extras or args.batch_bench:
         # the batched-lane throughput scenario (PR-2 tentpole evidence):
@@ -2083,6 +2239,7 @@ def main() -> int:
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
          bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
          replay_day=replay_day, portfolio_ab=portfolio_ab,
+         decompose=decompose,
          env_stamp=_env_stamp(platform, ndev, env))
     return 0
 

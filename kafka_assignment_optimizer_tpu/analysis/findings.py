@@ -38,6 +38,7 @@ RULES: dict[str, str] = {
               "solver factory",
     "KAO111": "serve/router outbound HTTP without causal-trace "
               "injection",
+    "KAO112": "per-partition Python for loop in a decompose hot module",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -61,6 +61,16 @@ caught only dynamically, alignment- or platform-dependently):
   references the injection vocabulary nor threads caller-supplied
   headers; read-only telemetry fan-outs with no request context carry
   justified suppressions.
+- **KAO112** per-partition Python ``for`` loops in the decompose hot
+  modules (``decompose/split.py``, ``decompose/stitch.py``): the
+  split/stitch phases run on the ultra-jumbo flat instance (200k+
+  partitions, docs/DECOMPOSE.md) BEFORE any solve starts, so an
+  interpreter loop over ``range(...num_parts)`` (or a name bound from
+  it) there is pure host stall added to every decomposed solve's cold
+  path — all per-partition work must be vectorized numpy (bincount /
+  fancy-index gathers); Python loops may range only over groups and
+  racks. Same detector as KAO109, scoped to the decompose modules.
+  Suppressible with justification for genuine cold fallbacks.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -169,6 +179,7 @@ def lint_source(
     out += _rule_traced_branch(tree, path)
     out += _rule_chaos_in_traced(tree, path)
     out += _rule_partition_loop(tree, path, rel)
+    out += _rule_decompose_loop(tree, path, rel)
     out += _rule_lane_config_capture(tree, path)
     out += _rule_uninjected_http(tree, path, rel)
     sup = parse_suppressions(text)
@@ -590,7 +601,16 @@ def _rule_partition_loop(tree, path, rel) -> list[Finding]:
     (``# kao: disable=KAO109 -- reason``)."""
     if not rel.endswith(_PARTITION_HOT_FILES):
         return []
+    return _partition_loop_findings(
+        tree, path, "KAO109",
+        "per-partition Python `for` loop in a bound/reseat hot "
+        "module: this is host time on every solve's certificate "
+        "critical path — vectorize over the padded arrays "
+        "(docs/CONSTRUCTOR.md) or suppress with justification "
+        "for a genuine cold fallback")
 
+
+def _partition_loop_findings(tree, path, code, msg) -> list[Finding]:
     # names assigned (anywhere in the module) from a .num_parts read —
     # catches the `P = inst.num_parts` / `for p in range(P)` split
     part_names: set[str] = set()
@@ -617,14 +637,36 @@ def _rule_partition_loop(tree, path, rel) -> list[Finding]:
             for a in it.args
         )
         if hit:
-            out.append(Finding(
-                "KAO109", path, n.lineno,
-                "per-partition Python `for` loop in a bound/reseat hot "
-                "module: this is host time on every solve's certificate "
-                "critical path — vectorize over the padded arrays "
-                "(docs/CONSTRUCTOR.md) or suppress with justification "
-                "for a genuine cold fallback"))
+            out.append(Finding(code, path, n.lineno, msg))
     return out
+
+
+# ---------------------------------------------------------------- KAO112
+
+# the decompose hot modules: split/stitch run over the ultra-jumbo
+# FLAT instance before any solve starts (docs/DECOMPOSE.md), so
+# per-partition interpreter loops there are host stalls added to every
+# decomposed solve's cold path — Python loops may range only over
+# groups and racks
+_DECOMPOSE_HOT_FILES = ("decompose/split.py", "decompose/stitch.py")
+
+
+def _rule_decompose_loop(tree, path, rel) -> list[Finding]:
+    """KAO109's detector scoped to the decompose hot modules: flag
+    ``for`` loops over ``range(...num_parts)`` (or a name bound from
+    it) in ``decompose/split.py`` / ``decompose/stitch.py``.
+    Deliberate cold fallbacks carry a justified suppression
+    (``# kao: disable=KAO112 -- reason``)."""
+    if not rel.endswith(_DECOMPOSE_HOT_FILES):
+        return []
+    return _partition_loop_findings(
+        tree, path, "KAO112",
+        "per-partition Python `for` loop in a decompose hot module: "
+        "split/stitch run over the ultra-jumbo FLAT instance before "
+        "any solve starts, so this is host stall on every decomposed "
+        "cold path — vectorize with bincount/fancy-index gathers "
+        "(docs/DECOMPOSE.md); loops may range only over groups/racks, "
+        "or suppress with justification for a genuine cold fallback")
 
 
 def _bound_names(fn) -> set[str]:

@@ -76,6 +76,16 @@ def build_parser() -> argparse.ArgumentParser:
         "debugging escape hatch; results are bit-identical either way",
     )
     ap.add_argument(
+        "--decompose",
+        action="store_true",
+        help="force the decomposed map-reduce solve path (tpu solver; "
+        "docs/DECOMPOSE.md): split the AZ/rack-structured instance "
+        "into per-AZ sub-instances, solve them as one lane-padded "
+        "batch, stitch and oracle-verify the global plan. Auto-"
+        "selected above KAO_DECOMPOSE_AUTO_PARTS partitions; "
+        "KAO_DECOMPOSE=0 disables everywhere",
+    )
+    ap.add_argument(
         "--checkpoint",
         metavar="PATH",
         help="warm-start from / save the best plan to this .npz (tpu solver); "
@@ -346,6 +356,8 @@ def _run(args: argparse.Namespace) -> int:
         kw["time_limit_s"] = args.time_limit
     if args.no_pipeline:
         kw["pipeline"] = False
+    if args.decompose:
+        kw["decompose"] = True
 
     res = optimize(
         current,

@@ -1,0 +1,71 @@
+"""Process-global decompose counters — the ``kao_decompose_*``
+metric families (serve.py /metrics) and the /healthz ``decompose``
+section both read one snapshot, so the views can never disagree.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# counter suffixes, pre-declared at zero in /metrics (the PR 11
+# rollout-counter discipline: a scrape-time family appearing only
+# after its first increment breaks rate() over restarts)
+COUNTER_NAMES = (
+    "solves",        # decomposed solves that returned a stitched plan
+    "certified",     # ... with a global optimality certificate
+    "gap_reported",  # ... that reported a bound gap instead
+    "fallback",      # decompose_to_flat degradations (failed reduce)
+    "unsplittable",  # instances the splitter declined (no structure)
+    "subproblems",   # sub-instances solved across all map phases
+    "iterations",    # map<->reduce iterations across all solves
+)
+
+
+class DecomposeStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {k: 0 for k in COUNTER_NAMES}
+        self._last: dict = {}
+
+    def note_solve(self, *, subproblems: int, iterations: int,
+                   certified: bool, bound_gap: int | None,
+                   sub_shape: dict | None) -> None:
+        with self._lock:
+            self._c["solves"] += 1
+            self._c["subproblems"] += int(subproblems)
+            self._c["iterations"] += int(iterations)
+            if certified:
+                self._c["certified"] += 1
+            else:
+                self._c["gap_reported"] += 1
+            self._last = {
+                "subproblems": int(subproblems),
+                "iterations": int(iterations),
+                "certified": bool(certified),
+                "bound_gap": 0 if certified else int(bound_gap or 0),
+                "sub_shape": dict(sub_shape or {}),
+            }
+
+    def note_fallback(self, iterations: int = 0,
+                      subproblems: int = 0) -> None:
+        with self._lock:
+            self._c["fallback"] += 1
+            self._c["iterations"] += int(iterations)
+            self._c["subproblems"] += int(subproblems)
+
+    def note_unsplittable(self) -> None:
+        with self._lock:
+            self._c["unsplittable"] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self._c), "last": dict(self._last)}
+
+    def reset(self) -> None:
+        """Test isolation only."""
+        with self._lock:
+            self._c = {k: 0 for k in COUNTER_NAMES}
+            self._last = {}
+
+
+STATS = DecomposeStats()

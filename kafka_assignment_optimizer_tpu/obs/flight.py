@@ -622,6 +622,18 @@ def record_solve(result, inst=None, acc: _SolveAcc | None = None,
             # config produced the plan, whether a first-to-certify
             # boundary retired the ladder, and when
             rec["portfolio"] = dict(st["portfolio"])
+        if st.get("decompose"):
+            # map-reduce provenance (docs/DECOMPOSE.md): sub-problem
+            # count, map<->reduce iterations, and the certificate-or-
+            # bound-gap outcome of the stitched plan
+            d = st["decompose"]
+            rec["decompose"] = {
+                "subproblems": d.get("subproblems"),
+                "iterations": d.get("iterations"),
+                "boundary_parts": d.get("boundary_parts"),
+                "certified": bool(d.get("certified")),
+                "bound_gap": d.get("bound_gap"),
+            }
         for key, v in {**ctx, **(extra or {})}.items():
             if key != "kind" and key not in rec:
                 rec[key] = v

@@ -215,6 +215,12 @@ def _latency_pairs(old: dict, new: dict) -> list[tuple[str, float, float]]:
     oro, nro = old.get("rollout") or {}, new.get("rollout") or {}
     for k in ("pack_s", "replan_s", "total_s"):
         add(f"rollout.{k}", oro.get(k), nro.get(k))
+    # decomposed rung (docs/DECOMPOSE.md): the ultra-jumbo cold wall is
+    # the tentpole latency number — the decomposed-vs-flat speedup is
+    # compared as a throughput ratio below, not double-counted here
+    odc, ndc = old.get("decompose") or {}, new.get("decompose") or {}
+    add("decompose.ultra_jumbo_cold_s", odc.get("ultra_jumbo_cold_s"),
+        ndc.get("ultra_jumbo_cold_s"))
     # fleet latency: p99 ONLY — p50 and p99 of the same closed-loop
     # run move together, and two correlated draws must not fill the
     # suspect quorum as independent evidence (the same reasoning that
@@ -249,6 +255,11 @@ def _throughput_pairs(old: dict,
     ofl, nfl = old.get("fleet") or {}, new.get("fleet") or {}
     add("fleet.throughput", ofl.get("throughput"),
         nfl.get("throughput"))
+    # decomposed-vs-flat speedup (docs/DECOMPOSE.md): higher means the
+    # map-reduce rung buys more over the flat path at the A/B size
+    odc, ndc = old.get("decompose") or {}, new.get("decompose") or {}
+    add("decompose.speedup", odc.get("decompose_speedup"),
+        ndc.get("decompose_speedup"))
     return pairs
 
 
@@ -264,6 +275,7 @@ _DETERMINISTIC_KEYS = (
     ("batch_throughput", ("lanes_feasible", "moves_at_bound")),
     ("rollout", ("caps_ok", "terminal_ok")),
     ("fleet", ("affinity_ok", "quality_ok", "spread_ok", "dropped")),
+    ("decompose", ("stitched_feasible", "gap_ok")),
 )
 
 
@@ -364,6 +376,16 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
             and nfl["dropped"] > 0):
         regs.append({"metric": "fleet.dropped",
                      "old": 0, "new": nfl["dropped"]})
+    # decomposed-rung quality (docs/DECOMPOSE.md): the oracle-checked
+    # stitched feasibility and the certificate-or-gap verdict are
+    # deterministic — a stitch that stops satisfying the ORIGINAL flat
+    # instance, or a bound gap blowing past the tolerance, is a
+    # confirmed regression, never annealer luck
+    odc, ndc = old.get("decompose") or {}, new.get("decompose") or {}
+    for k in ("stitched_feasible", "gap_ok"):
+        if odc.get(k) is True and ndc.get(k) is False:
+            regs.append({"metric": f"decompose.{k}",
+                         "old": True, "new": False})
     return regs
 
 
@@ -499,6 +521,10 @@ def seed_slowdown(artifact: dict, factor: float) -> dict:
     if isinstance(fl, dict):
         scale(fl, "p99_s", f)
         scale(fl, "throughput", 1.0 / f)
+    dc = art.get("decompose")
+    if isinstance(dc, dict):
+        scale(dc, "ultra_jumbo_cold_s", f)
+        scale(dc, "decompose_speedup", 1.0 / f)
     return art
 
 

@@ -59,6 +59,9 @@ POINTS: dict[str, tuple[str, str]] = {
         "solvers.tpu.engine", "chunk running far past its warm estimate"),
     "checkpoint_write": (
         "solvers.tpu.engine", "checkpoint persistence write failure"),
+    "decompose_reduce": (
+        "decompose", "reduce-phase boundary/stitch failure "
+        "(degrades decompose_to_flat)"),
     "worker_crash": (
         "serve", "solve worker thread dies mid-request"),
     "queue_overload": (

@@ -44,6 +44,7 @@ RUNGS: tuple[str, ...] = (
     "deadline_truncated",   # budget bit: ladder stopped early, best-so-far
     "checkpoint_skipped",   # checkpoint write failed; solve continued
     "warm_start_rejected",  # delta-API warm seed unusable; solved cold
+    "decompose_to_flat",    # failed map-reduce stitch; flat solve instead
     "sweep_to_chain",       # defaulted sweep infeasible; chain engine retry
     "anneal_to_construct",  # device path unusable; host greedy/constructor
     "worker_restart",       # serve worker crashed; respawned (+1 retry)

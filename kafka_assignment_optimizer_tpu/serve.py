@@ -186,7 +186,7 @@ MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
 ALLOWED_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
     "time_limit_s", "t_hi", "t_lo", "n_devices", "pipeline",
-    "portfolio",
+    "portfolio", "decompose",
 })
 
 # saturation policy: how long a request waits for a queue slot before
@@ -957,6 +957,34 @@ def render_metrics() -> str:
             f'kao_portfolio_winner_total{{lane="{lane}"}} '
             f"{port_winners[lane]}"
         )
+    # decomposed map-reduce solves (docs/DECOMPOSE.md): the full
+    # counter set is pre-declared at zero (the rollout-counter
+    # discipline), plus the last solve's certificate-or-gap outcome
+    from .decompose import STATS as _dstats
+
+    dsnap = _dstats.snapshot()
+    lines.append("# HELP kao_decompose_total decomposed map-reduce "
+                 "solve events, by kind (docs/DECOMPOSE.md)")
+    lines.append("# TYPE kao_decompose_total counter")
+    for k in sorted(dsnap["counters"]):
+        lines.append(
+            f'kao_decompose_total{{kind="{k}"}} '
+            f'{dsnap["counters"][k]}'
+        )
+    lines.append("# HELP kao_decompose_last_bound_gap bound gap of "
+                 "the last decomposed solve (0 when certified)")
+    lines.append("# TYPE kao_decompose_last_bound_gap gauge")
+    lines.append(
+        f"kao_decompose_last_bound_gap "
+        f'{int(dsnap["last"].get("bound_gap") or 0)}'
+    )
+    lines.append("# HELP kao_decompose_last_subproblems sub-problem "
+                 "count of the last decomposed solve")
+    lines.append("# TYPE kao_decompose_last_subproblems gauge")
+    lines.append(
+        f"kao_decompose_last_subproblems "
+        f'{int(dsnap["last"].get("subproblems") or 0)}'
+    )
     # load sheds by reason: every 503 names why it shed, and the full
     # reason set is pre-declared at zero so dashboards can alert on
     # rate() without waiting for the first shed
@@ -1517,6 +1545,13 @@ def handle_submit(
         options["portfolio"], bool
     ):
         raise ApiError(400, "'portfolio' must be a boolean")
+    # decomposed map-reduce solves (docs/DECOMPOSE.md): bool only —
+    # group structure comes from the cluster's rack names, never the
+    # client
+    if "decompose" in options and not isinstance(
+        options["decompose"], bool
+    ):
+        raise ApiError(400, "'decompose' must be a boolean")
     if max_solve_s is not None:
         # cap every solve: client may tighten the limit but not exceed it
         options["time_limit_s"] = (
@@ -2129,6 +2164,10 @@ def handle_healthz() -> dict:
         # single-path sweep solve races right now — width 1 means
         # --no-portfolio (or KAO_NO_PORTFOLIO) turned racing off
         "portfolio": _healthz_portfolio(),
+        # decomposed map-reduce rung (docs/DECOMPOSE.md): selection
+        # mode, sub-bucket ladder, counters, and whether the last
+        # sub-bucket's map-lane executable is warm in-process
+        "decompose": _healthz_decompose(),
         "observability": {
             "trace_enabled": bool(OBS["trace"]),
             "solve_reports_held": len(_otrace.RECENT.ids()),
@@ -2197,6 +2236,16 @@ def _healthz_portfolio() -> dict:
         # slot and the order currently racing (KAO_PORTFOLIO_ADAPT)
         "adapt": portfolio_adapt_snapshot(),
     }
+
+
+def _healthz_decompose() -> dict:
+    """The /healthz decompose section (docs/DECOMPOSE.md): selection
+    config, the sub-bucket ladder the map phase pads into, counters,
+    and the map-lane executable warm state — one snapshot shared with
+    the kao_decompose_* metric families so the views agree."""
+    from .decompose import config_snapshot
+
+    return config_snapshot()
 
 
 def _healthz_slo() -> dict:
@@ -2402,6 +2451,22 @@ def handle_warmup(
     warm_portfolio = payload.get("portfolio", True)
     if not isinstance(warm_portfolio, bool):
         raise ApiError(400, "warmup 'portfolio' must be a boolean")
+    # decompose warmup (docs/DECOMPOSE.md): "decompose": true (2
+    # groups) or an explicit group count precompiles the MAP-phase
+    # lane executable for each shape's sub-bucket — the shape a
+    # decomposed solve actually dispatches — so the first ultra-jumbo
+    # request finds the map phase warm
+    warm_decompose = payload.get("decompose", False)
+    if warm_decompose is True:
+        warm_decompose = 2
+    if warm_decompose is not False and not (
+        isinstance(warm_decompose, int)
+        and not isinstance(warm_decompose, bool)
+        and 2 <= warm_decompose <= 16
+    ):
+        raise ApiError(
+            400, "warmup 'decompose' must be a boolean or a group "
+                 "count 2..16")
     parsed = [_parse_warmup_shape(sh) for sh in shapes]
 
     from .solvers.tpu import bucket
@@ -2469,6 +2534,11 @@ def handle_warmup(
             row.update(_warmup_portfolio(
                 current, broker_list, topo, max_solve_s, lock_wait_s,
             ))
+        if warm_decompose:
+            row.update(_warmup_decompose(
+                b, p, r, k, int(warm_decompose), engine, max_solve_s,
+                lock_wait_s,
+            ))
         results.append(row)
     return {"warmed": results, "cache": bucket.STATS.snapshot()}
 
@@ -2516,6 +2586,62 @@ def _warmup_portfolio(current, broker_list, topo,
         ),
         "portfolio_wall_s": round(wall, 3),
         "portfolio_already_warm": (
+            after["compiles_total"] == before["compiles_total"]
+        ),
+    }
+
+
+def _warmup_decompose(b: int, p: int, r: int, k: int, groups: int,
+                      engine: str, max_solve_s: float | None,
+                      lock_wait_s: float) -> dict:
+    """Precompile the MAP-phase lane executable for one warmup shape's
+    decomposed sub-bucket: a decomposed solve of (B, P, R, K) splits
+    into ``groups`` sub-instances of ~(B/G, P/G, R, K/G) and dispatches
+    them as ONE lane-padded batch — so that batch executable, at lane
+    rung ``lane_bucket(groups)``, is what must be warm. Best-effort
+    like the lane/portfolio warmups."""
+    from .models.instance import build_instance
+    from .solvers.tpu import bucket
+    from .solvers.tpu.engine import solve_tpu_batch
+
+    bg = max(b // groups, r, 1)
+    pg = max(p // groups, 1)
+    kg = max(min(k // groups if k >= groups else k, bg), 1)
+
+    def _job():
+        t0 = time.perf_counter()
+        current, broker_list, topo = _synthetic_cluster(bg, pg, r, kg)
+        insts = [
+            build_instance(current, broker_list, topo)
+            for _ in range(groups)
+        ]
+        kw: dict = {"seeds": list(range(groups)), "engine": engine,
+                    "precompile": True}
+        if max_solve_s is not None:
+            kw["time_limit_s"] = max_solve_s
+        solve_tpu_batch(insts, **kw)
+        return time.perf_counter() - t0
+
+    before = bucket.STATS.snapshot()
+    try:
+        wall = _SOLVES.submit(
+            _job, wait_s=lock_wait_s, budget_s=max_solve_s
+        )
+    except Exception as e:  # best-effort: the single-path row stands
+        _olog.warn("warmup_decompose_failed", error=repr(e)[:200])
+        return {"decompose_error": repr(e)[:200]}
+    after = bucket.STATS.snapshot()
+    return {
+        "decompose_groups": groups,
+        "decompose_sub_shape": {
+            "brokers": bg, "partitions": pg, "rf": r, "racks": kg,
+        },
+        "decompose_lane_bucket": bucket.lane_bucket(groups),
+        "decompose_compiles": (
+            after["compiles_total"] - before["compiles_total"]
+        ),
+        "decompose_wall_s": round(wall, 3),
+        "decompose_already_warm": (
             after["compiles_total"] == before["compiles_total"]
         ),
     }

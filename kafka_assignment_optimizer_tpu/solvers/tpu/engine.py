@@ -370,6 +370,7 @@ def _solve_tpu(
     portfolio: bool | int | None = None,
     warm_start: "np.ndarray | None" = None,
     budget: Budget | None = None,
+    decompose: bool | None = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
@@ -411,6 +412,31 @@ def _solve_tpu(
     # instance would otherwise report the PREVIOUS solve's declines
     # (advisor r5: stale stats["flow_bound_declines"])
     inst._flow_big_declines = 0
+    # the "decomposed" rung of the bucket ladder (docs/DECOMPOSE.md,
+    # ROADMAP item 4): AZ/rack-structured instances past the flat
+    # ladder's reach (or opted in via --decompose / KAO_DECOMPOSE)
+    # solve as map-reduce over per-AZ sub-instances through the
+    # lane-padded batch executables, stitched + oracle-verified against
+    # THIS flat instance. A failed split/reduce returns None (the
+    # decompose_to_flat rung has been noted) and the flat path below
+    # proceeds untouched. Precompile solves warm flat executables by
+    # contract; the delta-API warm start and checkpoint resume are
+    # flat-plan shaped; multi-controller SPMD forbids host-side
+    # divergence — all four keep the flat path.
+    if (not precompile and warm_start is None and checkpoint is None
+            and _process_count() == 1):
+        from ...decompose import maybe_decompose, should_decompose
+
+        if should_decompose(inst, decompose):
+            dres = maybe_decompose(
+                inst, seed=seed, engine=engine,
+                time_limit_s=time_limit_s, budget=budget,
+                portfolio=portfolio, n_devices=n_devices,
+                rounds=rounds or sweeps, t_hi=t_hi, t_lo=t_lo,
+            )
+            if dres is not None:
+                inst.cancel_pending_bounds()
+                return dres
     enable_compile_cache()
     # backend init costs ~5 s over a tunneled TPU and the host-side
     # workers below (bounds prefetch, plan constructor) don't need the

@@ -517,6 +517,98 @@ def adv50k(
     )
 
 
+def ultra_jumbo(
+    n_az: int = 4, racks_per_az: int = 4, base_brokers: int = 8,
+    partitions: int = 200_000, rf: int = 3, cross_frac: float = 0.02,
+    seed: int = 0,
+) -> Scenario:
+    """ROADMAP item 4's instance family: an AZ/rack-structured
+    decommission sized past any flat bucket (default 200k partitions,
+    600k replica slots). Racks are heterogeneous (``base + r`` brokers
+    for rack ``r``) but the rack-size *multiset is identical across
+    AZs*, and the decommission removes one rack-0 broker per AZ — so
+    every AZ keeps the same (brokers, racks) shape and the decomposed
+    map phase can stack all AZ sub-instances into ONE lane-padded
+    executable (docs/DECOMPOSE.md). Most partitions live entirely
+    inside one AZ (per-AZ balanced topic blocks); a ``cross_frac``
+    sliver of partitions is placed with each replica in a *different*
+    AZ — the boundary family the reduce phase must reconcile."""
+    if rf > n_az:
+        raise ValueError(f"ultra_jumbo needs rf <= n_az ({rf} > {n_az})")
+    if racks_per_az <= rf:
+        # ceil(rf/K) pins part_rack_hi at 1 for big K: a group with
+        # only rf racks would force every partition onto ALL of them,
+        # colliding with the proportional rack bands
+        raise ValueError(
+            f"ultra_jumbo needs racks_per_az > rf "
+            f"({racks_per_az} <= {rf})")
+    # heterogeneous but FLAT rack sizes (base+0..base+racks-1): per-AZ
+    # rack-band admissibility needs the largest rack <= B_az/rf once
+    # part_rack_hi == 1 (docs/DECOMPOSE.md "split criteria")
+    rack_sizes = [base_brokers + r for r in range(racks_per_az)]
+    rack_of: dict[str, str] = {}
+    az_brokers: list[list[int]] = []
+    removed: list[int] = []
+    bid = 0
+    for g in range(n_az):
+        mine: list[int] = []
+        for r, sz in enumerate(rack_sizes):
+            for _ in range(sz):
+                rack_of[str(bid)] = f"az{g}-rack{r}"
+                mine.append(bid)
+                bid += 1
+        az_brokers.append(mine)
+        removed.append(mine[base_brokers - 1])  # last rack-0 broker
+    topo = Topology.from_dict(rack_of)
+    all_brokers = [b for mine in az_brokers for b in mine]
+
+    cross = int(partitions * cross_frac)
+    per_az = (partitions - cross) // n_az
+    cross = partitions - per_az * n_az  # exact total
+    parts: list[PartitionAssignment] = []
+    for g in range(n_az):
+        blk = balanced_assignment(
+            az_brokers[g], topo, {f"az{g}": per_az}, rf
+        )
+        parts.extend(blk.partitions)
+    # boundary family: replica j of cross partition p lives in AZ
+    # (seed + p + j) % n_az, walking each AZ's rack-interleaved order —
+    # every replica a distinct AZ (hence a distinct rack)
+    orders = [_rack_interleaved(mine, topo) for mine in az_brokers]
+    for p in range(cross):
+        reps = [
+            orders[(seed + p + j) % n_az][(p * rf + j) % len(orders[0])]
+            for j in range(rf)
+        ]
+        parts.append(
+            PartitionAssignment(topic="xaz", partition=p, replicas=reps)
+        )
+    current = Assignment(partitions=parts)
+    gone = set(removed)
+    lb = sum(1 for pa in current.partitions for b in pa.replicas
+             if b in gone)
+    return Scenario(
+        name="ultra_jumbo",
+        current=current,
+        broker_list=[b for b in all_brokers if b not in gone],
+        topology=topo,
+        min_moves_lb=lb,
+        notes=(
+            f"{len(all_brokers)}b/{n_az}az/{partitions}-part AZ-structured "
+            f"decommission of one broker per AZ ({lb} replicas), "
+            f"{cross} cross-AZ boundary partitions"
+        ),
+    )
+
+
+def ultra_jumbo_case(seed: int = 0, partitions: int = 200_000) -> Scenario:
+    """The ISSUE 16 entry point: the AZ-structured ultra-jumbo
+    decommission at the requested size, seeded for reproducible
+    boundary placement. Tests and bench both consume this wrapper so
+    the decomposed path is always measured on the same family."""
+    return ultra_jumbo(partitions=partitions, seed=seed)
+
+
 def messy_cluster(rng):
     """One deliberately irregular worst-case cluster (the property
     fuzz's messy family, docs/ANALYSIS.md): several topics with
@@ -571,6 +663,7 @@ SCENARIOS = {
     "adversarial": adversarial,
     "adv50k": adv50k,
     "jumbo": jumbo,
+    "ultra_jumbo": ultra_jumbo,
 }
 
 # shrunk per-scenario kwargs for quick CPU smoke runs: the single source of
@@ -587,4 +680,5 @@ SMOKE_KWARGS = {
     "adv50k": dict(n_brokers=48, n_topics_low=6, n_topics_high=6,
                    parts_per_topic=10),
     "jumbo": dict(n_brokers=48, n_topics=10, parts_per_topic=40),
+    "ultra_jumbo": dict(n_az=3, racks_per_az=4, partitions=600),
 }

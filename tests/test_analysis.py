@@ -427,6 +427,56 @@ def test_kao111_uninjected_http_in_serving_tier():
     assert _rules(_lint(sup, rel="serve.py")) == []
 
 
+# ---------------------------------------------------------------- KAO112
+
+POS_112 = """
+    import numpy as np
+
+    def stitch(inst, plans):
+        out = np.full((inst.num_parts, 3), -1)
+        for p in range(inst.num_parts):
+            out[p] = plans[p]
+        return out
+"""
+
+NEG_112_GROUP_LOOP = """
+    import numpy as np
+
+    def split(inst, n_groups):
+        subs = []
+        for g in range(n_groups):  # groups, not partitions: fine
+            subs.append(g)
+        return subs
+"""
+
+
+def test_kao112_partition_loop_in_decompose_modules():
+    # the rule is path-scoped to the decompose hot modules
+    assert "KAO112" in _rules(_lint(POS_112, rel="decompose/split.py"))
+    assert "KAO112" in _rules(_lint(POS_112, rel="decompose/stitch.py"))
+    # the KAO109 name-bound variant triggers here too (shared detector)
+    assert "KAO112" in _rules(
+        _lint(POS_109_SPLIT, rel="decompose/split.py")
+    )
+    # loops over groups/racks are the sanctioned shape
+    assert "KAO112" not in _rules(
+        _lint(NEG_112_GROUP_LOOP, rel="decompose/split.py")
+    )
+    # out of scope: the orchestrator may loop (it ranges over lanes),
+    # and the bound/reseat modules stay KAO109's business, not 112's
+    assert "KAO112" not in _rules(
+        _lint(POS_112, rel="decompose/__init__.py")
+    )
+    assert "KAO112" not in _rules(_lint(POS_112, rel="models/bounds.py"))
+    # suppressible with justification, like every rule
+    sup = POS_112.replace(
+        "for p in range(inst.num_parts):",
+        "for p in range(inst.num_parts):  "
+        "# kao: disable=KAO112 -- cold fallback, never on the hot path",
+    )
+    assert _rules(_lint(sup, rel="decompose/split.py")) == []
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

@@ -1,0 +1,239 @@
+"""Map-reduce decomposition (the PR-16 tentpole, docs/DECOMPOSE.md).
+
+Pins the contracts the decomposed rung rests on:
+
+- the splitter's global-band inheritance makes the stitched plan
+  feasible for the ORIGINAL flat instance (the oracle check is a
+  redundant proof, and the engine runs it anyway);
+- the result always carries a certificate or an honest bound gap —
+  never silence about decomposition loss;
+- the sub-instances stack as lanes of ONE lane-padded executable, so
+  a second decomposed solve in the same process compiles nothing;
+- any reduce-phase fault degrades via the ``decompose_to_flat``
+  ladder rung on all three views (counter, stats, log) and the flat
+  path still lands a feasible plan;
+- triggering is explicit or auto-by-size, and never engages on
+  precompile/warm-start/checkpoint flows.
+"""
+
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.decompose import (
+    STATS as DSTATS,
+    maybe_decompose,
+    should_decompose,
+)
+from kafka_assignment_optimizer_tpu.decompose.split import (
+    infer_groups,
+    split,
+)
+from kafka_assignment_optimizer_tpu.decompose.stitch import stitch
+from kafka_assignment_optimizer_tpu.obs import flight
+from kafka_assignment_optimizer_tpu.resilience import chaos, ladder
+from kafka_assignment_optimizer_tpu.solvers.tpu import bucket
+from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu
+from kafka_assignment_optimizer_tpu.utils import gen
+
+
+def _smoke_instance(seed=0):
+    sc = gen.ultra_jumbo(seed=seed, **gen.SMOKE_KWARGS["ultra_jumbo"])
+    return build_instance(**sc.kwargs)
+
+
+@pytest.fixture(scope="module")
+def smoke_inst():
+    return _smoke_instance()
+
+
+@pytest.fixture(scope="module")
+def decomposed(smoke_inst):
+    """One forced decomposed solve shared by the read-only pins."""
+    res = solve_tpu(smoke_inst, seed=0, decompose=True, rounds=6)
+    return smoke_inst, res
+
+
+# ------------------------------------------------------------- split
+
+
+def test_infer_groups_requires_az_prefixes():
+    inst = _smoke_instance()
+    got = infer_groups(inst)
+    assert got is not None
+    names, g_rack = got
+    assert names == ["az0", "az1", "az2"]
+    assert g_rack.shape == (inst.num_racks,)
+    # a flat topology (no '-' prefix grouping) is not decomposable
+    flat = build_instance(**gen.decommission(n_brokers=32, n_topics=4, parts_per_topic=50).kwargs)
+    assert infer_groups(flat) is None
+    assert split(flat) is None
+
+
+def test_split_partitions_axes_and_inherits_bands(smoke_inst):
+    sp = split(smoke_inst)
+    assert sp is not None
+    assert sp.n_groups == 3
+    # brokers and racks are PARTITIONED: every index in exactly one
+    # group, no group empty
+    all_b = np.concatenate(sp.broker_idx)
+    assert sorted(all_b.tolist()) == list(range(smoke_inst.num_brokers))
+    all_p = np.concatenate(sp.part_idx)
+    assert sorted(all_p.tolist()) == list(range(smoke_inst.num_parts))
+    assert sp.uniform_shape  # the stacking invariant
+    for g, sub in enumerate(sp.subs):
+        # global scalar bands inherited verbatim; rack arrays sliced
+        assert sub.broker_lo == smoke_inst.broker_lo
+        assert sub.broker_hi == smoke_inst.broker_hi
+        assert sub.leader_lo == smoke_inst.leader_lo
+        assert sub.leader_hi == smoke_inst.leader_hi
+        racks_g = np.nonzero(sp.group_of_rack == g)[0]
+        np.testing.assert_array_equal(sub.rack_lo,
+                                      smoke_inst.rack_lo[racks_g])
+        np.testing.assert_array_equal(
+            sub.part_rack_hi, smoke_inst.part_rack_hi[sp.part_idx[g]])
+        # weights travel with their (partition, broker) pairs
+        cols = np.append(sp.broker_idx[g], smoke_inst.num_brokers)
+        np.testing.assert_array_equal(
+            sub.w_leader,
+            smoke_inst.w_leader[np.ix_(sp.part_idx[g], cols)])
+
+
+def test_stitch_translates_lane_plans_to_global_ids(smoke_inst):
+    sp = split(smoke_inst)
+    # a fake per-lane plan: every partition's slot 0 on local broker 0,
+    # rest null — the stitch must translate to each group's first
+    # GLOBAL broker and leave nulls null
+    R = smoke_inst.a0.shape[1]
+    plans = []
+    for sub in sp.subs:
+        a = np.full((sub.num_parts, R), sub.num_brokers, np.int32)
+        a[:, 0] = 0
+        plans.append(a)
+    a = stitch(smoke_inst, sp, plans)
+    B = smoke_inst.num_brokers
+    for g in range(sp.n_groups):
+        np.testing.assert_array_equal(
+            a[sp.part_idx[g], 0], sp.broker_idx[g][0])
+    assert (a[:, 1:] == B).all()
+
+
+# ------------------------------------------- the decomposed solve
+
+
+def test_decomposed_solve_feasible_with_provenance(decomposed):
+    inst, res = decomposed
+    assert res.stats["engine"] == "decomposed"
+    assert res.stats["feasible"]
+    # the oracle proof on the ORIGINAL flat instance, re-run here
+    assert sum(inst.violations(res.a).values()) == 0
+    d = res.stats["decompose"]
+    assert d["subproblems"] == 3
+    assert d["groups"] == ["az0", "az1", "az2"]
+    assert d["uniform_shape"] is True
+    assert d["sub_shape"]["lane_bucket"] >= d["subproblems"]
+    assert res.stats["bucket_parts"] == d["sub_shape"]["bucket_parts"]
+
+
+def test_certificate_or_gap_always_reported(decomposed):
+    _, res = decomposed
+    d = res.stats["decompose"]
+    assert isinstance(d["certified"], bool)
+    if not d["certified"]:
+        # an honest non-negative gap against the FLAT upper bound
+        assert isinstance(d["bound_gap"], int)
+        assert d["bound_gap"] >= 0
+    else:
+        assert res.stats["proved_optimal"]
+
+
+def test_flight_record_carries_decompose_block(smoke_inst):
+    res = solve_tpu(smoke_inst, seed=3, decompose=True, rounds=6)
+    recs = [r for r in flight.recent(20, kind="solve")
+            if r.get("decompose")]
+    assert recs, "no solve record with a decompose block"
+    rec = recs[-1]
+    d = res.stats["decompose"]
+    assert rec["decompose"]["subproblems"] == d["subproblems"]
+    assert rec["decompose"]["certified"] == d["certified"]
+    assert rec["decompose"]["bound_gap"] == d["bound_gap"]
+    # ONE record for the whole solve: the map lanes are suppressed
+    assert rec["engine"] == "decomposed"
+
+
+def test_second_decomposed_solve_compiles_nothing(smoke_inst):
+    # the fixture (or a prior test) already warmed the lane executable
+    solve_tpu(smoke_inst, seed=1, decompose=True, rounds=6)
+    before = bucket.STATS.snapshot()
+    res = solve_tpu(smoke_inst, seed=2, decompose=True, rounds=6)
+    after = bucket.STATS.snapshot()
+    assert res.stats["engine"] == "decomposed"
+    assert after["compiles_total"] == before["compiles_total"], (
+        before, after)
+
+
+# ------------------------------------------------- degradation
+
+
+def test_reduce_fault_degrades_to_flat_three_views(smoke_inst):
+    before_rung = ladder.snapshot().get("decompose_to_flat", 0)
+    before_fb = DSTATS.snapshot()["counters"]["fallback"]
+    chaos.arm("decompose_reduce")
+    try:
+        res = solve_tpu(smoke_inst, seed=0, decompose=True, rounds=6)
+    finally:
+        chaos.disarm()
+    # the flat path landed a feasible plan anyway
+    assert res.stats.get("engine") != "decomposed"
+    assert sum(smoke_inst.violations(res.a).values()) == 0
+    # three-view agreement: ladder counter, ambient stats, decompose
+    # counters (the log line rides note_rung)
+    assert ladder.snapshot()["decompose_to_flat"] == before_rung + 1
+    assert "decompose_to_flat" in res.stats.get("degradations", [])
+    assert DSTATS.snapshot()["counters"]["fallback"] == before_fb + 1
+
+
+def test_unsplittable_instance_falls_through_to_flat():
+    before = DSTATS.snapshot()["counters"]["unsplittable"]
+    inst = build_instance(**gen.decommission(n_brokers=32, n_topics=4, parts_per_topic=50).kwargs)
+    res = solve_tpu(inst, seed=0, decompose=True)
+    assert res.stats.get("engine") != "decomposed"
+    assert res.stats["feasible"]
+    assert DSTATS.snapshot()["counters"]["unsplittable"] == before + 1
+
+
+# ------------------------------------------------- triggering
+
+
+def test_should_decompose_kwarg_env_auto(monkeypatch):
+    inst = _smoke_instance()
+    # explicit kwarg wins over everything
+    assert should_decompose(inst, True) is True
+    assert should_decompose(inst, False) is False
+    # env force
+    monkeypatch.setenv("KAO_DECOMPOSE", "1")
+    assert should_decompose(inst, None) is True
+    monkeypatch.setenv("KAO_DECOMPOSE", "0")
+    assert should_decompose(inst, None) is False
+    # auto: below the default 150k threshold the smoke case stays flat
+    monkeypatch.delenv("KAO_DECOMPOSE", raising=False)
+    assert should_decompose(inst, None) is False
+    monkeypatch.setenv("KAO_DECOMPOSE_AUTO_PARTS",
+                       str(inst.num_parts))
+    assert should_decompose(inst, None) is True
+
+
+def test_warm_start_and_precompile_skip_decompose(smoke_inst,
+                                                 monkeypatch):
+    # even force-on, the engine's gate keeps adapted-plan warm starts
+    # and precompile passes on the flat path
+    monkeypatch.setenv("KAO_DECOMPOSE", "1")
+    before = DSTATS.snapshot()["counters"]["solves"]
+    res = solve_tpu(smoke_inst, seed=0, precompile=True)
+    assert res.stats.get("engine") != "decomposed"
+    assert DSTATS.snapshot()["counters"]["solves"] == before
+
+
+def test_maybe_decompose_returns_none_on_flat_topology():
+    inst = build_instance(**gen.decommission(n_brokers=32, n_topics=4, parts_per_topic=50).kwargs)
+    assert maybe_decompose(inst, seed=0) is None

@@ -183,6 +183,26 @@ def test_rollout_families_predeclared_at_zero():
     assert "kao_rollout_active" in names  # the gauge rides along
 
 
+def test_decompose_families_predeclared_at_zero():
+    """PR 16 satellite: the kao_decompose_* families render (at zero)
+    before the first decomposed solve ever runs, every kind label
+    pre-declared, with HELP/TYPE pairs — same contract as rollout."""
+    from kafka_assignment_optimizer_tpu.decompose.stats import (
+        COUNTER_NAMES,
+    )
+
+    text = srv.render_metrics()
+    samples = validate_prometheus(text)
+    names = {n for n, _ in samples}
+    assert "kao_decompose_total" in names
+    assert "kao_decompose_last_bound_gap" in names
+    assert "kao_decompose_last_subproblems" in names
+    kinds = {dict(lbl).get("kind") for n, lbl in samples
+             if n == "kao_decompose_total"}
+    for k in COUNTER_NAMES:
+        assert k in kinds, (k, kinds)
+
+
 def test_metrics_http_content_type():
     """ISSUE 9 satellite: /metrics serves the Prometheus text
     exposition content type (version 0.0.4) over real HTTP."""

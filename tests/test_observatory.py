@@ -378,6 +378,11 @@ def _artifact(**over) -> dict:
         "batch_throughput": {"b1": 1.0, "b2": 1.8, "b4": 3.0,
                              "b8": 5.0, "lanes_feasible": True,
                              "moves_at_bound": True},
+        "decompose": {"ultra_parts": 200_000,
+                      "ultra_jumbo_cold_s": 42.0, "sub_problems": 4,
+                      "bound_gap": 160, "certified": False,
+                      "stitched_feasible": True, "gap_ok": True,
+                      "decompose_speedup": 3.5},
     }
     art.update(over)
     return art
@@ -435,6 +440,34 @@ def test_regress_headline_not_double_counted_with_rows():
             if k not in ("scenarios", "rows_schema")}
     names = [n for n, _, _ in oregress._latency_pairs(bare, bare)]
     assert "headline_warm_s" in names
+
+
+def test_regress_decompose_keys():
+    """PR 16 satellite: the decompose artifact block participates in
+    the gate — ultra-jumbo cold wall as latency, decomposed-vs-flat
+    speedup as throughput, stitched_feasible/gap_ok as deterministic
+    quality trips."""
+    art = _artifact()
+    lat = [n for n, _, _ in oregress._latency_pairs(art, art)]
+    assert "decompose.ultra_jumbo_cold_s" in lat
+    thr = [n for n, _, _ in oregress._throughput_pairs(art, art)]
+    assert "decompose.speedup" in thr
+    # seed_slowdown scales both, in opposite directions
+    slow = oregress.seed_slowdown(art, 2.0)
+    assert slow["decompose"]["ultra_jumbo_cold_s"] == 84.0
+    assert slow["decompose"]["decompose_speedup"] == 1.75
+    # a verdict flip is a confirmed quality regression
+    bad = json.loads(json.dumps(art))
+    bad["decompose"]["stitched_feasible"] = False
+    v = oregress.compare(art, bad)
+    assert v["verdict"] == "regression"
+    assert any(r["metric"] == "decompose.stitched_feasible"
+               for r in v["quality_regressions"])
+    bad2 = json.loads(json.dumps(art))
+    bad2["decompose"]["gap_ok"] = False
+    v2 = oregress.compare(art, bad2)
+    assert any(r["metric"] == "decompose.gap_ok"
+               for r in v2["quality_regressions"])
 
 
 def test_regress_quality_regression_is_noise_free():

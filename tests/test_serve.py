@@ -422,9 +422,45 @@ def test_warmup_endpoint_precompiles_bucket(server_url):
     for bad in ({}, {"shapes": []}, {"shapes": ["x"]},
                 {"shapes": [{"brokers": 2, "partitions": 4, "rf": 3}]},
                 {"shapes": [[8, 24]], "engine": "bogus"},
-                {"shapes": [[8, 24]], "lanes": "yes"}):
+                {"shapes": [[8, 24]], "lanes": "yes"},
+                {"shapes": [[8, 24]], "decompose": "yes"},
+                {"shapes": [[8, 24]], "decompose": 99}):
         status, body = post_to(server_url, "/warmup", bad)
         assert status == 400, (bad, body)
+
+
+def test_healthz_decompose_section_and_warmup(server_url):
+    """PR 16 satellite: /healthz carries the decompose config/counters
+    and /warmup {"decompose": true} precompiles the map-lane shape."""
+    with urllib.request.urlopen(server_url + "/healthz", timeout=30) as r:
+        body = json.loads(r.read())
+    dec = body["decompose"]
+    assert dec["mode"] in ("auto", "on", "off")
+    assert dec["auto_parts"] >= 1 and dec["max_iters"] >= 1
+    assert isinstance(dec["sub_bucket_ladder"], list)
+    assert isinstance(dec["map_lane_warm"], bool)
+    for k in ("solves", "fallback", "unsplittable"):
+        assert k in dec["counters"], dec
+    # decompose warmup rides the shape rows: sub-shapes derived from
+    # the flat shape, solved through the REAL batch path as one
+    # lane-padded precompile
+    shape = {"brokers": 12, "partitions": 60, "rf": 2, "racks": 3}
+    status, out = post_to(server_url, "/warmup",
+                          {"shapes": [shape], "lanes": False,
+                           "decompose": True})
+    assert status == 200, out
+    row = out["warmed"][0]
+    assert row["decompose_groups"] == 2
+    assert row["decompose_lane_bucket"] >= 2
+    assert row["decompose_wall_s"] > 0
+    # a second decompose warmup of the same shape is all cache hits
+    status, out2 = post_to(server_url, "/warmup",
+                           {"shapes": [shape], "lanes": False,
+                            "decompose": True})
+    assert status == 200, out2
+    row2 = out2["warmed"][0]
+    assert row2.get("decompose_already_warm") is True, row2
+    assert row2.get("decompose_compiles") == 0, row2
 
 
 def test_landing_page_front_door(server_url):
