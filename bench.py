@@ -371,6 +371,48 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
             nopipe.append(time.perf_counter() - t0)
         if min(walls[1:]) > 0:
             pipeline_speedup = round(min(nopipe) / min(walls[1:]), 3)
+    # megachunk A/B (ISSUE 17, docs/PIPELINE.md): the same warm search
+    # solve with K=8 chunks fused per dispatch. The fused scan is a
+    # DIFFERENT executable, so run 0 pays its compile and best-of-rest
+    # is the measured arm. Two verdicts ride the artifact: the wall
+    # ratio (chunked best-warm / fused best-warm, >= 1.0 means fusion
+    # pays) and the deterministic parity gate — the fused plan must be
+    # bit-identical to the chunked plan whenever both walked the same
+    # rounds (a deadline-shortened ladder is noise, not a regression).
+    megachunk_speedup = megachunk_ab = None
+    if warm and knobs:
+        import numpy as np
+
+        mwalls, mres = [], None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            mres = optimize(solver="tpu", seed=seed, trace=True,
+                            megachunk=8, **knobs, **sc.kwargs)
+            mwalls.append(time.perf_counter() - t0)
+        st, mst = res.solve.stats, mres.solve.stats
+        parity_ok = None
+        if st.get("rounds_run") == mst.get("rounds_run"):
+            parity_ok = bool(np.array_equal(mres.solve.a, res.solve.a))
+        if min(walls[1:]) > 0 and min(mwalls[1:]) > 0:
+            megachunk_speedup = round(
+                min(walls[1:]) / min(mwalls[1:]), 3)
+        dchunked, dmega = st.get("dispatches"), mst.get("dispatches")
+        megachunk_ab = {
+            "k": (mst.get("megachunk") or {}).get("k"),
+            "wall_chunked_s": round(min(walls[1:]), 3),
+            "wall_mega_s": round(min(mwalls[1:]), 3),
+            "dispatches_chunked": dchunked,
+            "dispatches_mega": dmega,
+            # the headline dispatch-amplification claim: >= 4.0 at K=8
+            # on a warm >= 8-chunk ladder (fewer chunks cap it)
+            "dispatch_reduction": (
+                round(dchunked / dmega, 2)
+                if dchunked and dmega else None
+            ),
+            "duty_cycle_mega": _duty_cycle(mst),
+            "feasible_mega": mres.report()["feasible"],
+            "parity_ok": parity_ok,
+        }
     default_wall = default_proved = None
     if knobs:
         t0 = time.perf_counter()
@@ -419,6 +461,15 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         # pipeline-on/off A/B on the warm search rows (null elsewhere)
         "pipeline_speedup": pipeline_speedup,
         "pipeline": res.solve.stats.get("pipeline"),
+        # ladder dispatch accounting (ISSUE 17): host->device round
+        # trips this solve paid, and the device share of the busy wall
+        # (device_s / (device_s + dispatch_s)) — the number megachunk
+        # fusion exists to raise
+        "dispatches_per_solve": res.solve.stats.get("dispatches"),
+        "duty_cycle": _duty_cycle(res.solve.stats),
+        # chunked-vs-fused A/B on the warm search rows (null elsewhere)
+        "megachunk_speedup": megachunk_speedup,
+        **({"megachunk_ab": megachunk_ab} if megachunk_ab else {}),
         **({"bucket_reuse": bucket_reuse} if bucket_reuse else {}),
         "moves": report["replica_moves"],
         "min_moves_lb": sc.min_moves_lb,
@@ -446,6 +497,17 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
         ),
         **_sampler_block(sampler),
     }
+
+
+def _duty_cycle(stats: dict) -> float | None:
+    """Device share of the ladder's busy wall, from the solve stats'
+    measured split — the same device_s/(device_s + dispatch_s) the
+    flight recorder stamps (obs/flight.py), so artifact and flight
+    views can never disagree."""
+    device_s = float(stats.get("device_s") or 0.0)
+    dispatch_s = float(stats.get("dispatch_s") or 0.0)
+    busy = device_s + dispatch_s
+    return round(device_s / busy, 4) if busy > 0 else None
 
 
 def _sampler_block(sampler) -> dict:
@@ -1515,7 +1577,8 @@ ROW_SCHEMA = ("scenario,warm_s,cold_s,moves,min_moves_lb,feasible,"
               "proved_optimal,constructed,engine,path,compile_s,"
               "cache_compiles,cache_hits,"
               "phase_s[bounds,constructor,seed,ladder,polish,verify],"
-              "pipeline_speedup,construct_host_s")
+              "pipeline_speedup,construct_host_s,"
+              "dispatches_per_solve,duty_cycle,megachunk_speedup")
 
 
 def _compact_row(r: dict | None, name: str, err: str | None) -> list:
@@ -1524,7 +1587,7 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
     if r is None:
         return [name, None, None, None, None, 0, 0, 0, "error",
                 (err or "failed")[:80], None, None, None, None, None,
-                None]
+                None, None, None, None]
     cache = r.get("cache") or {}
     ph = r.get("phase_s") or {}
     return [
@@ -1550,6 +1613,12 @@ def _compact_row(r: dict | None, name: str, err: str | None) -> list:
         # constructor host seconds: bounds_flow + greedy + reseat +
         # adopt summed from the solve report (ISSUE 10)
         r.get("construct_host_s"),
+        # ladder dispatch accounting (ISSUE 17): host round trips per
+        # solve, the device share of the busy wall, and the
+        # chunked/fused best-warm ratio (warm search rows only)
+        r.get("dispatches_per_solve"),
+        r.get("duty_cycle"),
+        r.get("megachunk_speedup"),
     ]
 
 
@@ -1692,6 +1761,7 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
          replay_day: dict | None = None,
          portfolio_ab: dict | None = None,
          decompose: dict | None = None,
+         megachunk_ab: dict | None = None,
          env_stamp: dict | None = None) -> None:
     """Print full detail to stderr, then ONE compact stdout JSON line."""
     if head is None:
@@ -1797,6 +1867,11 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # decomposed-vs-flat speedup, certificate-or-gap verdict
         # (docs/DECOMPOSE.md)
         line["decompose"] = decompose
+    if megachunk_ab:
+        # fused-megachunk A/B (ISSUE 17): chunked-vs-fused warm walls,
+        # dispatch reduction at K=8, fused duty cycle, and the
+        # bit-identical-plan parity verdict (docs/PIPELINE.md)
+        line["megachunk_ab"] = megachunk_ab
     if "device_sampler" in head:
         # device-occupancy evidence for the headline run: duty cycle,
         # per-device memory, and the sampler's measured overhead
@@ -2101,6 +2176,7 @@ def main() -> int:
     rows: list[list] = []
     cold_cached: float | None = None
     bucket_reuse: dict | None = None
+    megachunk_ab: dict | None = None
     for name in names:
         is_head = name == args.scenario
         # the adversarial rows are the at-scale proof of the SEARCH
@@ -2130,6 +2206,8 @@ def main() -> int:
         rows.append(_compact_row(r, name, err))
         if r is not None and r.get("bucket_reuse") and bucket_reuse is None:
             bucket_reuse = r["bucket_reuse"]
+        if r is not None and r.get("megachunk_ab") and megachunk_ab is None:
+            megachunk_ab = r["megachunk_ab"]
         if args.all:
             print(json.dumps(r if r is not None else {"scenario": name,
                                                       "error": err}),
@@ -2239,7 +2317,7 @@ def main() -> int:
          jumbo_runs=jumbo_runs, search_cold_runs=search_cold_runs,
          bucket_reuse=bucket_reuse, batch_throughput=batch_throughput,
          replay_day=replay_day, portfolio_ab=portfolio_ab,
-         decompose=decompose,
+         decompose=decompose, megachunk_ab=megachunk_ab,
          env_stamp=_env_stamp(platform, ndev, env))
     return 0
 

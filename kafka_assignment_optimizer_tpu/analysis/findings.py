@@ -39,6 +39,8 @@ RULES: dict[str, str] = {
     "KAO111": "serve/router outbound HTTP without causal-trace "
               "injection",
     "KAO112": "per-partition Python for loop in a decompose hot module",
+    "KAO113": "host sync inside a scan body (serializes a fused "
+              "megachunk)",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -71,6 +71,17 @@ caught only dynamically, alignment- or platform-dependently):
   fancy-index gathers); Python loops may range only over groups and
   racks. Same detector as KAO109, scoped to the decompose modules.
   Suppressible with justification for genuine cold fallbacks.
+- **KAO113** host-sync primitives inside ``lax.scan`` bodies: the
+  megachunk contract (ISSUE 17, docs/PIPELINE.md) is that a fused
+  K-chunk scan runs device-resident end to end — early exit is a
+  masked no-op on the carry, never a host decision. A ``.item()`` /
+  ``.tolist()`` call, an ``np.asarray``/``np.array``/
+  ``jax.device_get`` of a scan-bound value, or a Python
+  ``if``/``while`` on the scan carry inside the body either crashes
+  at trace time (ConcretizationTypeError / TracerArrayConversionError)
+  or — worse — silently forces a mid-scan host round-trip and the
+  fused dispatch degenerates to per-chunk latency. Detected on any
+  function passed as the body of a ``lax.scan`` call.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -182,6 +193,7 @@ def lint_source(
     out += _rule_decompose_loop(tree, path, rel)
     out += _rule_lane_config_capture(tree, path)
     out += _rule_uninjected_http(tree, path, rel)
+    out += _rule_scan_host_sync(tree, path)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -840,6 +852,103 @@ def _rule_uninjected_http(tree, path, rel) -> list[Finding]:
                 "traffic should carry a justified suppression")
             for call in calls
         )
+    return out
+
+
+# ---------------------------------------------------------------- KAO113
+
+# host-materialization shapes inside a scan body: numpy constructors
+# that concretize a tracer, and jax's explicit device->host fetch.
+# jnp.asarray stays legal — it is functional and traces fine.
+_HOST_SYNC_NP = {"asarray", "array", "ascontiguousarray"}
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+
+
+def _scan_bodies(tree):
+    """Functions passed as the body (first argument) of a ``lax.scan``
+    call: named defs resolved module-wide by name, plus inline
+    lambdas. Everything inside one is traced by construction."""
+    named: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and n.args:
+            chain = _dotted(n.func)
+            if chain[-1:] == ["scan"]:
+                f = n.args[0]
+                if isinstance(f, ast.Name):
+                    named.add(f.id)
+                elif isinstance(f, ast.Lambda):
+                    lambdas.append(f)
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name in named:
+            yield n
+    yield from lambdas
+
+
+def _rule_scan_host_sync(tree, path) -> list[Finding]:
+    """Host-sync primitives inside ``lax.scan`` bodies (the megachunk
+    contract, ISSUE 17 / docs/PIPELINE.md): ``.item()``/``.tolist()``,
+    ``np.asarray``/``np.array``/``jax.device_get`` of a scan-bound
+    value, and Python ``if``/``while`` on the carry. Inside a fused
+    megachunk scan these either crash at trace time or silently force
+    a mid-scan host round-trip — exit decisions must stay on-device
+    as masked no-ops on the carry."""
+    out = []
+    seen: set[int] = set()
+
+    def note(lineno, msg):
+        if lineno not in seen:
+            seen.add(lineno)
+            out.append(Finding("KAO113", path, lineno, msg))
+
+    for fn in _scan_bodies(tree):
+        params = {
+            a.arg for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+        }
+        bound = (
+            _bound_names(fn)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else set(params)
+        )
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _HOST_SYNC_ATTRS:
+                    note(node.lineno,
+                         f".{node.func.attr}() inside a lax.scan body "
+                         "is a device->host sync: it crashes at trace "
+                         "time or forces a mid-scan round-trip; keep "
+                         "the decision on-device in the carry "
+                         "(docs/PIPELINE.md megachunks)")
+                    continue
+                chain = _dotted(node.func)
+                is_np_sync = (
+                    len(chain) == 2 and chain[0] in ("np", "numpy")
+                    and chain[1] in _HOST_SYNC_NP
+                )
+                is_device_get = chain[-1:] == ["device_get"]
+                if (is_np_sync or is_device_get) and node.args and any(
+                    isinstance(sub, ast.Name) and sub.id in bound
+                    for sub in ast.walk(node.args[0])
+                ):
+                    note(node.lineno,
+                         f"{'.'.join(chain)} of a scan-bound value "
+                         "inside a lax.scan body: concretizing a "
+                         "tracer is a host sync (TracerArray"
+                         "ConversionError at best); stay in jnp "
+                         "(docs/PIPELINE.md megachunks)")
+            elif isinstance(node, (ast.If, ast.While)) \
+                    and _test_touches_traced(node.test, params):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                note(node.lineno,
+                     f"Python `{kind}` on the scan carry inside a "
+                     "lax.scan body: the carry is traced — branch "
+                     "with jnp.where / lax.cond so the fused "
+                     "megachunk stays device-resident "
+                     "(docs/PIPELINE.md)")
     return out
 
 

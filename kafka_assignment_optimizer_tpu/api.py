@@ -66,6 +66,11 @@ class OptimizeResult:
             # produced the plan belongs on the serving surface
             **({"solver_portfolio": dict(self.solve.stats["portfolio"])}
                if self.solve.stats.get("portfolio") else {}),
+            # fused-ladder provenance (docs/PIPELINE.md "Megachunks"):
+            # also a dict — resolved width, chooser mode, dispatches,
+            # executed chunks, early_exit
+            **({"solver_megachunk": dict(self.solve.stats["megachunk"])}
+               if self.solve.stats.get("megachunk") else {}),
         }
 
 

@@ -76,6 +76,17 @@ def build_parser() -> argparse.ArgumentParser:
         "debugging escape hatch; results are bit-identical either way",
     )
     ap.add_argument(
+        "--megachunk",
+        default=None,
+        metavar="K|auto|off",
+        help="fused ladder megachunks (tpu sweep engine; "
+        "docs/PIPELINE.md): run K consecutive schedule chunks as ONE "
+        "device-resident scan dispatch — bit-identical to the "
+        "per-chunk ladder, K fewer host round-trips. An int pins the "
+        "width, 'auto' reads the per-bucket evidence table, 'off' "
+        "keeps the per-chunk dispatcher (same as KAO_MEGACHUNK)",
+    )
+    ap.add_argument(
         "--decompose",
         action="store_true",
         help="force the decomposed map-reduce solve path (tpu solver; "
@@ -219,6 +230,24 @@ def _spec_text(spec: str) -> str:
     return p.read_text() if p.exists() else spec
 
 
+def parse_megachunk(spec: str):
+    """``--megachunk``: an int width, 'auto', or 'off'. A typo fails
+    loudly (the engine-side resolver is tolerant because it also eats
+    env values; the CLI's contract is exit 2 on bad flags)."""
+    v = spec.strip().lower()
+    if v == "auto":
+        return "auto"
+    if v in ("off", "0", "none"):
+        return "off"
+    try:
+        return max(1, int(v))
+    except ValueError:
+        raise ValueError(
+            f"--megachunk {spec!r}: expected an integer width, "
+            "'auto', or 'off'"
+        ) from None
+
+
 def parse_rf(spec: str | None) -> int | dict | None:
     """``--rf``: an int, inline JSON object, or a JSON file path."""
     if spec is None:
@@ -356,6 +385,8 @@ def _run(args: argparse.Namespace) -> int:
         kw["time_limit_s"] = args.time_limit
     if args.no_pipeline:
         kw["pipeline"] = False
+    if args.megachunk is not None:
+        kw["megachunk"] = parse_megachunk(args.megachunk)
     if args.decompose:
         kw["decompose"] = True
 
@@ -469,6 +500,8 @@ def _run_events(args: argparse.Namespace) -> int:
         kw["time_limit_s"] = args.time_limit
     if args.no_pipeline:
         kw["pipeline"] = False
+    if args.megachunk is not None:
+        kw["megachunk"] = parse_megachunk(args.megachunk)
 
     def solve_fn(state, prev_plan, budget):
         res = optimize_delta(

@@ -541,12 +541,21 @@ def _split(stats: dict, acc: _SolveAcc | None, wall_s: float) -> dict:
     dispatch_s = float(stats.get("dispatch_s") or 0.0)
     compile_s = round(acc.compile_s, 4) if acc else 0.0
     host_s = max(wall_s - device_s - max(dispatch_s, compile_s), 0.0)
-    return {
+    out = {
         "compile_s": compile_s,
         "device_s": round(device_s, 4),
         "dispatch_s": round(dispatch_s, 4),
         "host_s": round(host_s, 4),
     }
+    # ladder dispatch count + duty cycle (ISSUE 17): duty is the
+    # fraction of the solve's device-facing wall the device was
+    # actually computing — megachunk fusion raises it by collapsing
+    # per-chunk enqueue round-trips (docs/OBSERVABILITY.md)
+    if stats.get("dispatches") is not None:
+        out["dispatches"] = int(stats["dispatches"])
+        busy = device_s + dispatch_s
+        out["duty_cycle"] = round(device_s / busy, 4) if busy > 0 else None
+    return out
 
 
 def record_solve(result, inst=None, acc: _SolveAcc | None = None,
@@ -622,6 +631,11 @@ def record_solve(result, inst=None, acc: _SolveAcc | None = None,
             # config produced the plan, whether a first-to-certify
             # boundary retired the ladder, and when
             rec["portfolio"] = dict(st["portfolio"])
+        if st.get("megachunk"):
+            # fused-ladder provenance (ISSUE 17, docs/PIPELINE.md):
+            # resolved width + chooser mode, group/chunk counts, and
+            # whether an on-device certificate retired the scan
+            rec["megachunk"] = dict(st["megachunk"])
         if st.get("decompose"):
             # map-reduce provenance (docs/DECOMPOSE.md): sub-problem
             # count, map<->reduce iterations, and the certificate-or-

@@ -227,6 +227,14 @@ def _latency_pairs(old: dict, new: dict) -> list[tuple[str, float, float]]:
     # excludes the headline fields when scenario rows are present)
     ofl, nfl = old.get("fleet") or {}, new.get("fleet") or {}
     add("fleet.p99_s", ofl.get("p99_s"), nfl.get("p99_s"))
+    # fused-megachunk arm (docs/PIPELINE.md): the fused warm wall ONLY
+    # — wall_chunked_s is the adversarial warm_s already compared
+    # above, and the speedup ratio is those two walls divided (quorum
+    # honesty: one independent draw, counted once)
+    oma, nma = old.get("megachunk_ab") or {}, \
+        new.get("megachunk_ab") or {}
+    add("megachunk_ab.wall_mega_s", oma.get("wall_mega_s"),
+        nma.get("wall_mega_s"))
     return pairs
 
 
@@ -260,6 +268,20 @@ def _throughput_pairs(old: dict,
     odc, ndc = old.get("decompose") or {}, new.get("decompose") or {}
     add("decompose.speedup", odc.get("decompose_speedup"),
         ndc.get("decompose_speedup"))
+    # ladder dispatch accounting (ISSUE 17, docs/PIPELINE.md): the
+    # device share of the busy wall per scenario (higher = less host
+    # round-trip overhead), and the fused arm's measured dispatch
+    # amplification at K=8 (a counter ratio, not a wall clock — near
+    # deterministic, so a drop is strong evidence). The fused wall
+    # itself is a latency pair; megachunk_speedup is those two walls
+    # divided and is NOT double-counted here.
+    for sc in sorted(set(orows) & set(nrows)):
+        add(f"{sc}.duty_cycle", orows[sc].get("duty_cycle"),
+            nrows[sc].get("duty_cycle"))
+    oma, nma = old.get("megachunk_ab") or {}, \
+        new.get("megachunk_ab") or {}
+    add("megachunk_ab.dispatch_reduction", oma.get("dispatch_reduction"),
+        nma.get("dispatch_reduction"))
     return pairs
 
 
@@ -276,6 +298,7 @@ _DETERMINISTIC_KEYS = (
     ("rollout", ("caps_ok", "terminal_ok")),
     ("fleet", ("affinity_ok", "quality_ok", "spread_ok", "dropped")),
     ("decompose", ("stitched_feasible", "gap_ok")),
+    ("megachunk_ab", ("parity_ok", "feasible_mega")),
 )
 
 
@@ -385,6 +408,19 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
     for k in ("stitched_feasible", "gap_ok"):
         if odc.get(k) is True and ndc.get(k) is False:
             regs.append({"metric": f"decompose.{k}",
+                         "old": True, "new": False})
+    # fused-megachunk quality (ISSUE 17, docs/PIPELINE.md): the fused
+    # scan's bit-identical-plan parity and the fused plan's feasibility
+    # are deterministic — a K=8 megachunk producing a different (or
+    # infeasible) plan than the per-chunk ladder is a confirmed
+    # trajectory break, never annealer luck. parity_ok is null when
+    # the two arms walked different round counts (deadline noise);
+    # null never trips the gate.
+    oma, nma = old.get("megachunk_ab") or {}, \
+        new.get("megachunk_ab") or {}
+    for k in ("parity_ok", "feasible_mega"):
+        if oma.get(k) is True and nma.get(k) is False:
+            regs.append({"metric": f"megachunk_ab.{k}",
                          "old": True, "new": False})
     return regs
 

@@ -468,6 +468,148 @@ def _compiled_lane_solver(
     return fn, cache_key
 
 
+def _compiled_mega_solver(
+    mesh: Mesh,
+    chains_per_device: int,
+    steps_per_round: int,
+    engine: str = "sweep",
+    scorer: str = "xla",
+    lanes: bool = False,
+):
+    """Jitted shard_map host for the FUSED megachunk steppers
+    (docs/PIPELINE.md): K chunk steps scanned inside one executable,
+    single-instance (``lanes=False``) or lane-batched. Cached next to
+    the per-chunk solvers under a ``"mega"`` / ``"mega-lanes"`` tag —
+    the fused width K is NOT part of this key because jit's shape
+    keying (and ``_arg_signature`` in the AOT executable cache) already
+    splits on the ``temps [K, c]`` stack, so each (bucket, K) pair owns
+    exactly one executable and a warm re-solve at the same width never
+    compiles. State donation is identical to the per-chunk path: the
+    scan carry's leaves alias the input buffers leaf-for-leaf."""
+    if engine != "sweep":
+        raise ValueError("megachunk fusion is sweep-engine only")
+    cache_key = (
+        tuple(d.id for d in mesh.devices.flat),
+        chains_per_device, steps_per_round, engine, scorer,
+        "mega-lanes" if lanes else "mega",
+    )
+    with _COMPILED_LOCK:
+        fn = _COMPILED.get(cache_key)
+        if fn is not None:
+            _COMPILED[cache_key] = _COMPILED.pop(cache_key)
+    if fn is None:
+        from ..solvers.tpu.sweep import (
+            make_mega_lane_stepper_fn,
+            make_mega_stepper_fn,
+        )
+
+        build = make_mega_lane_stepper_fn if lanes else make_mega_stepper_fn
+        solve = build(chains_per_device, axis_name=AXIS, scorer=scorer)
+
+        def shard_fn(m_arg, state, temps, active, cert_k, cert_mv):
+            state = jax.tree.map(lambda x: x[0], state)
+            (state, top_a, top_k, cert_a, cert_ok, cert_mvs, curves,
+             execd) = solve(m_arg, state, temps, active, cert_k, cert_mv)
+            state = jax.tree.map(lambda x: x[None], state)
+            return (state, top_a[None], top_k[None], cert_a[None],
+                    cert_ok[None], cert_mvs[None], curves[None],
+                    execd[None])
+
+        in_specs = (P(), P(AXIS), P(), P(), P(), P())
+        out_specs = (P(AXIS),) * 8
+        fn = jax.jit(
+            _shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+            ),
+            donate_argnums=(1,),
+        )
+        with _COMPILED_LOCK:
+            fn = _COMPILED.setdefault(cache_key, fn)
+            while len(_COMPILED) > _COMPILED_MAX:
+                _COMPILED.pop(next(iter(_COMPILED)))
+    return fn, cache_key
+
+
+def _mega_args(m_arg, state, temps_stack, active, cert_k, cert_mv):
+    from ..solvers.tpu.sweep import MEGA_DISARMED_KEY, MEGA_DISARMED_MOVES
+
+    k_steps = int(np.asarray(temps_stack).shape[0] if hasattr(
+        temps_stack, "shape") else len(temps_stack))
+    if active is None:
+        active = np.ones((k_steps,), bool)
+    if cert_k is None:
+        cert_k = MEGA_DISARMED_KEY
+    if cert_mv is None:
+        cert_mv = MEGA_DISARMED_MOVES
+    return (
+        m_arg, state, jnp.asarray(temps_stack),
+        jnp.asarray(np.asarray(active, bool)),
+        jnp.asarray(cert_k, jnp.int32), jnp.asarray(cert_mv, jnp.int32),
+    )
+
+
+def solve_megachunk(
+    m: ModelArrays,
+    mesh: Mesh,
+    chains_per_device: int,
+    temps_stack: jax.Array,
+    state,
+    *,
+    active=None,
+    cert_k=None,
+    cert_mv=None,
+    steps_per_round: int = 1,
+    scorer: str = "xla",
+):
+    """One fused dispatch over K chunk steps: ``temps_stack [K, c]``
+    (every group at one bucket shares c and K — short tails pad temps
+    and clear ``active``), state from :func:`init_sweep_state` or any
+    prior chunk/megachunk. Returns ``(state', top_a [n_dev, P, R],
+    top_k [n_dev], cert_a [n_dev, P, R], cert_ok [n_dev], cert_mv
+    [n_dev], curves [n_dev, K, c], execd [n_dev, K])`` — the engine
+    expands ``curves``/``execd`` back into per-chunk records. Omitting
+    the cert args dispatches the group disarmed (sentinels that never
+    fire)."""
+    fn, solver_key = _compiled_mega_solver(
+        mesh, chains_per_device, steps_per_round, "sweep", scorer
+    )
+    return _dispatch(fn, solver_key, _mega_args(
+        m, state, temps_stack, active, cert_k, cert_mv
+    ))
+
+
+def solve_lanes_megachunk(
+    m_stack,
+    mesh: Mesh,
+    chains_per_device: int,
+    temps_stack: jax.Array,
+    state,
+    *,
+    active=None,
+    cert_k=None,
+    cert_mv=None,
+    steps_per_round: int = 1,
+    scorer: str = "xla",
+):
+    """Lane-batched :func:`solve_megachunk`: L instances × K fused
+    chunk steps in one dispatch. Lane axes ride after the device axis
+    exactly as in :func:`solve_lanes` (``curves [n_dev, L, K, c]``,
+    ``execd [n_dev, L, K]``). Batch callers leave the cert args at
+    their disarmed defaults — independent instances must not share an
+    early exit; portfolio callers arm them to stop every lane on the
+    first certificate."""
+    fn, solver_key = _compiled_mega_solver(
+        mesh, chains_per_device, steps_per_round, "sweep", scorer,
+        lanes=True,
+    )
+    return _dispatch(fn, solver_key, _mega_args(
+        m_stack, state, temps_stack, active, cert_k, cert_mv
+    ))
+
+
 def init_lane_state(
     m_stack,
     lane_seeds: np.ndarray,

@@ -53,6 +53,9 @@ POINTS: dict[str, tuple[str, str]] = {
         "parallel.mesh", "executable-cache eviction storm"),
     "pallas_fault": (
         "solvers.tpu.engine", "Mosaic/Pallas kernel lowering fault"),
+    "megachunk_fault": (
+        "solvers.tpu.engine", "fault inside a fused megachunk scan "
+        "dispatch (drains to the per-chunk path)"),
     "nan_chunk": (
         "solvers.tpu.engine", "NaN surfacing from an annealing chunk"),
     "chunk_overrun": (

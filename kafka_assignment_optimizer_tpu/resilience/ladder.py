@@ -37,6 +37,7 @@ __all__ = [
 # for the host constructor/greedy path (flagged degraded unless it
 # certifies); the rest are serving/persistence containment steps.
 RUNGS: tuple[str, ...] = (
+    "megachunk_to_chunked",  # fused scan drained; per-chunk ladder re-entry
     "pipelined_to_sync",    # drain speculation, retry chunk synchronously
     "aot_to_jit",           # AOT executable path failed; plain jit dispatch
     "transfer_retry",       # device->host transfer retried after a fault

@@ -186,7 +186,7 @@ MAX_BODY_BYTES = 64 << 20  # 64 MiB — a 10k-partition cluster is ~1 MiB
 ALLOWED_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "steps_per_round", "engine",
     "time_limit_s", "t_hi", "t_lo", "n_devices", "pipeline",
-    "portfolio", "decompose",
+    "portfolio", "decompose", "megachunk",
 })
 
 # saturation policy: how long a request waits for a queue slot before
@@ -273,7 +273,7 @@ DEFAULT_MAX_BATCH = 8
 # other knob (e.g. steps_per_round) takes the single-solve path
 _BATCHABLE_OPTIONS = frozenset({
     "seed", "batch", "rounds", "sweeps", "engine", "time_limit_s",
-    "t_hi", "t_lo", "n_devices", "pipeline", "portfolio",
+    "t_hi", "t_lo", "n_devices", "pipeline", "portfolio", "megachunk",
 })
 # executable-accumulation hygiene: drop in-process jit caches after this
 # many completed solves (see _SolveQueue._maintenance)
@@ -1552,6 +1552,15 @@ def handle_submit(
         options["decompose"], bool
     ):
         raise ApiError(400, "'decompose' must be a boolean")
+    # fused ladder megachunks (docs/PIPELINE.md): bool only — the fused
+    # width is an operator knob (KAO_MEGACHUNK / --megachunk), never a
+    # per-request one (a client naming an arbitrary width could force
+    # fresh compiles per request). true opts the solve into the
+    # evidence-driven chooser, false pins the per-chunk ladder.
+    if "megachunk" in options and not isinstance(
+        options["megachunk"], bool
+    ):
+        raise ApiError(400, "'megachunk' must be a boolean")
     if max_solve_s is not None:
         # cap every solve: client may tighten the limit but not exceed it
         options["time_limit_s"] = (
@@ -2164,6 +2173,10 @@ def handle_healthz() -> dict:
         # single-path sweep solve races right now — width 1 means
         # --no-portfolio (or KAO_NO_PORTFOLIO) turned racing off
         "portfolio": _healthz_portfolio(),
+        # fused ladder megachunks (docs/PIPELINE.md): the effective
+        # default (--megachunk / KAO_MEGACHUNK), the per-bucket fusion
+        # evidence table, and the width "auto" would pick per bucket
+        "megachunk": _healthz_megachunk(),
         # decomposed map-reduce rung (docs/DECOMPOSE.md): selection
         # mode, sub-bucket ladder, counters, and whether the last
         # sub-bucket's map-lane executable is warm in-process
@@ -2236,6 +2249,16 @@ def _healthz_portfolio() -> dict:
         # slot and the order currently racing (KAO_PORTFOLIO_ADAPT)
         "adapt": portfolio_adapt_snapshot(),
     }
+
+
+def _healthz_megachunk() -> dict:
+    """The /healthz megachunk section (docs/PIPELINE.md): the resolved
+    process default plus the evidence table the "auto" chooser reads —
+    measured per-dispatch host overhead vs per-chunk device wall, and
+    the width each warmed bucket would fuse to right now."""
+    from .solvers.tpu.engine import megachunk_snapshot
+
+    return megachunk_snapshot()
 
 
 def _healthz_decompose() -> dict:
@@ -3146,6 +3169,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="disable portfolio lane racing by default "
                          "(docs/PORTFOLIO.md); clients may still opt a "
                          "request back in with options.portfolio=true")
+    ap.add_argument("--megachunk", default=None, metavar="K|auto|off",
+                    help="fused ladder megachunks (docs/PIPELINE.md): "
+                         "default fused width for sweep solves — an "
+                         "integer pins K chunks per dispatch, 'auto' "
+                         "engages the per-bucket evidence chooser, "
+                         "'off'/unset keeps the per-chunk ladder. Same "
+                         "as KAO_MEGACHUNK; clients may opt a request "
+                         "out with options.megachunk=false")
     ap.add_argument("--no-trace", action="store_true",
                     help="disable per-request solve traces (responses "
                          "then carry no trace_id and /debug/solves "
@@ -3340,6 +3371,14 @@ def main(argv: list[str] | None = None) -> int:
         from .solvers.tpu.engine import set_portfolio_default
 
         set_portfolio_default(False)
+    if args.megachunk is not None:
+        from .solvers.tpu.engine import set_megachunk_default
+
+        try:
+            set_megachunk_default(args.megachunk)
+        except ValueError:
+            ap.error(f"--megachunk {args.megachunk!r}: expected an "
+                     "integer width, 'auto', or 'off'")
     OBS["trace"] = not args.no_trace
     OBS["profile_dir"] = args.profile_dir
     OBS["profile_solves"] = args.profile_solves

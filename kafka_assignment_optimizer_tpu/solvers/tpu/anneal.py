@@ -38,6 +38,13 @@ from .arrays import (
     u01 as _shared_u01,
 )
 
+# fused ladder megachunks (docs/PIPELINE.md): the chain engine's
+# between-chunk reseed is a HOST data dependency — the global best must
+# round-trip to reseed every chain — so its chunks cannot fuse into one
+# device-resident scan. The engine checks this flag before resolving
+# KAO_MEGACHUNK; sweep.py carries the True side.
+SUPPORTS_MEGACHUNK = False
+
 # move-type proposal mix
 P_REPLACE = 0.45
 P_LSWAP = 0.10  # remainder goes to xswap

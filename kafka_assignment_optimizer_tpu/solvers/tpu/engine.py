@@ -177,6 +177,159 @@ def set_pipeline_default(enabled: bool) -> None:
     _PIPELINE_DEFAULT = bool(enabled)
 
 
+# ladder megachunks (ISSUE 17, docs/PIPELINE.md): fuse K consecutive
+# sweep chunks into ONE device-resident scan dispatch, so the warm
+# ladder pays one host round-trip per K chunks instead of per chunk.
+# KAO_MEGACHUNK=auto|1|K with the KAO_PORTFOLIO_ADAPT convention:
+# unset keeps the per-chunk path (static default — bit-for-bit the
+# pre-megachunk ladder), an integer pins the fused width, and "auto"
+# opts into the evidence-driven per-bucket chooser below — Automap-
+# style measure-then-choose, never a hand-written constant.
+def _env_megachunk():
+    """Parse KAO_MEGACHUNK: None (unset/off), "auto", or a width >= 1.
+    Malformed overrides fall back to unset instead of crashing the
+    first engine import (KAO_BUCKETS convention)."""
+    raw = os.environ.get("KAO_MEGACHUNK", "").strip().lower()
+    if not raw or raw in ("0", "off", "none", "false"):
+        return None
+    if raw == "auto":
+        return "auto"
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return None
+
+
+_MEGACHUNK_DEFAULT = _env_megachunk()
+
+
+def set_megachunk_default(value) -> None:
+    """Process-wide default for solves that do not pass ``megachunk=``
+    explicitly (serve's ``--megachunk`` flag lands here): None/"off"
+    keeps the per-chunk ladder, "auto" engages the evidence table, an
+    int pins the width."""
+    global _MEGACHUNK_DEFAULT
+    if isinstance(value, str):
+        value = value.strip().lower()
+        if value in ("", "0", "off", "none", "false"):
+            value = None
+        elif value != "auto":
+            value = max(1, int(value))
+    elif isinstance(value, bool):
+        value = "auto" if value else None
+    elif value is not None:
+        value = max(1, int(value))
+    _MEGACHUNK_DEFAULT = value
+
+
+def megachunk_default():
+    """The resolved process default (serve /healthz)."""
+    return _MEGACHUNK_DEFAULT
+
+
+# per-bucket fusion-width evidence (the PR 11/12 note_* style — see
+# arrays.note_portfolio_result): every sweep ladder files its measured
+# dispatch/device wall split under its executable identity, and the
+# "auto" chooser picks the smallest width that makes per-dispatch host
+# overhead a <= MEGA_HOST_FRACTION share of a fused group's wall. On
+# CPU test meshes dispatch overhead is a rounding error next to chunk
+# device time, so auto resolves to 1 and CI trajectories never move.
+_MEGA_CANDIDATES = (1, 2, 4, 8)
+MEGA_MIN_SOLVES = 16  # evidence quorum before auto departs from 1
+MEGA_HOST_FRACTION = 0.05
+_MEGA_LOCK = threading.Lock()
+_MEGA_EVIDENCE: dict = {}
+
+
+def note_megachunk_evidence(key: tuple, *, dispatches: int,
+                            dispatch_s: float, chunks: int,
+                            device_s: float) -> None:
+    """File one ladder's measured split under its executable identity
+    ``key``. Totals accumulate (means stay stable as solves land);
+    the table is process-local, like the portfolio adapt table."""
+    if dispatches <= 0 or chunks <= 0:
+        return
+    with _MEGA_LOCK:
+        ev = _MEGA_EVIDENCE.setdefault(key, {
+            "solves": 0, "dispatches": 0, "dispatch_s": 0.0,
+            "chunks": 0, "device_s": 0.0,
+        })
+        ev["solves"] += 1
+        ev["dispatches"] += int(dispatches)
+        ev["dispatch_s"] += float(dispatch_s)
+        ev["chunks"] += int(chunks)
+        ev["device_s"] += float(device_s)
+
+
+def choose_megachunk_k(key: tuple) -> int:
+    """Evidence-driven width for ``key``: with per-dispatch overhead
+    ``o`` and per-chunk device wall ``d``, the smallest candidate K
+    holding ``o <= MEGA_HOST_FRACTION * (o + K*d)`` — i.e. fuse just
+    enough that the host round-trip stops mattering. Returns 1 until
+    MEGA_MIN_SOLVES solves of evidence exist (never guesses)."""
+    with _MEGA_LOCK:
+        ev = _MEGA_EVIDENCE.get(key)
+        if ev is None or ev["solves"] < MEGA_MIN_SOLVES:
+            return 1
+        o = ev["dispatch_s"] / max(1, ev["dispatches"])
+        d = ev["device_s"] / max(1, ev["chunks"])
+    for k in _MEGA_CANDIDATES:
+        if o <= MEGA_HOST_FRACTION * (o + k * d):
+            return k
+    return _MEGA_CANDIDATES[-1]
+
+
+def megachunk_snapshot() -> dict:
+    """Evidence-table snapshot for /healthz and tests."""
+    with _MEGA_LOCK:
+        keys = list(_MEGA_EVIDENCE)
+        buckets = {
+            repr(k): dict(v) for k, v in _MEGA_EVIDENCE.items()
+        }
+    return {
+        "default": _MEGACHUNK_DEFAULT,
+        "min_solves": MEGA_MIN_SOLVES,
+        "host_fraction": MEGA_HOST_FRACTION,
+        "candidates": list(_MEGA_CANDIDATES),
+        "buckets": buckets,
+        "chosen": {repr(k): choose_megachunk_k(k) for k in keys},
+    }
+
+
+def reset_megachunk_adapt() -> None:
+    """Tests: drop accumulated fusion evidence."""
+    with _MEGA_LOCK:
+        _MEGA_EVIDENCE.clear()
+
+
+def _resolve_megachunk(megachunk, engine_mod_supports: bool, multi: bool,
+                       n_chunks: int, evidence_key: tuple) -> tuple:
+    """Resolve the ``megachunk`` knob to ``(K, mode)``. 1 unless the
+    engine supports fusion and the ladder has >1 chunk. Explicit param
+    beats the process default; "auto" reads the evidence table — but
+    never under multi-controller SPMD, where per-process evidence
+    could fork executables across workers and deadlock the pod
+    (explicit widths are process-invariant and stay allowed)."""
+    if not engine_mod_supports or n_chunks <= 1:
+        return 1, "off"
+    v = megachunk if megachunk is not None else _MEGACHUNK_DEFAULT
+    if isinstance(v, bool):
+        v = "auto" if v else None
+    if isinstance(v, str) and v.strip().lower() != "auto":
+        try:
+            v = max(1, int(v))
+        except ValueError:
+            v = None
+    if v is None or v == 1:
+        return 1, "off"
+    if v == "auto":
+        if multi:
+            return 1, "off"
+        k = choose_megachunk_k(evidence_key)
+        return (max(1, min(k, n_chunks)), "auto")
+    return max(1, min(int(v), n_chunks)), "static"
+
+
 class _WarmChunkRegistry:
     """Cross-solve warm per-chunk duration estimates, keyed by the
     executable identity a chunk actually dispatches — (path tag, mesh
@@ -371,6 +524,7 @@ def _solve_tpu(
     warm_start: "np.ndarray | None" = None,
     budget: Budget | None = None,
     decompose: bool | None = None,
+    megachunk: "bool | int | str | None" = None,
     **_unused,
 ) -> SolveResult:
     t0 = time.perf_counter()
@@ -566,7 +720,7 @@ def _solve_tpu(
             t_lo, n_devices, engine, checkpoint, profile_dir,
             time_limit_s, backend_fut, t0, bounds_fut,
             cert_min_savings_s, lp_fut, multi, lp_wait_s, pipeline,
-            budget, warm_start, portfolio,
+            budget, warm_start, portfolio, megachunk,
         )
     except Exception as e:
         # the degradation ladder's last rung (docs/RESILIENCE.md): a
@@ -1010,6 +1164,22 @@ def _await_constructor(lp_fut, lp_wait_s, checkpoint, budget: Budget):
         return None, None, lp_warm_extends
 
 
+class _CurveSlice:
+    """Per-chunk view over one fused group's async curve transfer:
+    ``get()`` slices chunk ``j`` out of the group's ``[..., K, rounds]``
+    curve block, so every downstream consumer (per-chunk stats curves,
+    the curve materialization at ladder end) sees exactly the arrays
+    the unfused ladder produced — one transfer per GROUP feeds K
+    per-chunk handles."""
+
+    def __init__(self, h, j: int, axis: int):
+        self._h, self._j, self._axis = h, j, axis
+
+    def get(self):
+        return np.take(np.asarray(self._h.get()), self._j,
+                       axis=self._axis)
+
+
 @dataclass
 class _LadderResult:
     """What the annealing ladder hands to final selection / stats."""
@@ -1030,6 +1200,11 @@ class _LadderResult:
     boundary_overlap_s: float = 0.0  # boundary work hidden behind device chunks
     winner_lane: int | None = None   # portfolio lane that certified first
     certified_at_s: float | None = None  # solve-relative first-certificate time
+    mega_k: int = 1            # fused width this ladder ran at (1 = unfused)
+    mega_groups: int = 0       # fused groups dispatched
+    dispatches: int = 0        # device dispatches (fused or not)
+    chunks_exec: int = 0       # schedule chunks that actually executed
+    mega_early_exit: bool = False  # a fused group exited on-device
 
 
 def _run_ladder(
@@ -1037,6 +1212,7 @@ def _run_ladder(
     scorer, chunks, seed_dev, key, sweep_state, lp_fut, bounds_fut,
     multi, cert_min_savings_s, budget, profile_dir,
     polish_starter=None, pipeline=True, warm_key=(), lanes: int = 0,
+    mega_k: int = 1,
 ) -> _LadderResult:
     """Stage 4 — the chunked annealing ladder: dispatch each schedule
     chunk to the mesh, then do the boundary work between chunks — adopt
@@ -1071,9 +1247,20 @@ def _run_ladder(
     the flattened [n_dev x lanes] set; only the ``lanes`` real lanes
     are read — padding lanes are inert by masking), and the first lane
     to certify retires the remaining ladder, recording its index as
-    ``winner_lane``."""
+    ``winner_lane``.
+
+    ``mega_k`` > 1 is the MEGACHUNK mode (ISSUE 17, docs/PIPELINE.md):
+    consecutive sweep chunks fuse into one device-resident scan
+    dispatch of width ``mega_k`` — one host round-trip retires K
+    chunks, with an on-device early-exit certificate test between
+    fused steps. Per-lane trajectories stay bit-identical to the
+    unfused ladder (the scan body IS the per-chunk step); any fault
+    inside a fused group drains to the per-chunk dispatchers via the
+    ``megachunk_to_chunked`` rung, re-entering at the first chunk the
+    group did not finish."""
     from ...parallel.mesh import (
-        fetch_global, fetch_global_async, solve_lanes, solve_on_mesh,
+        fetch_global, fetch_global_async, solve_lanes,
+        solve_lanes_megachunk, solve_megachunk, solve_on_mesh,
     )
 
     r = _LadderResult(scorer=scorer)
@@ -1100,11 +1287,35 @@ def _run_ladder(
     last_chunk_s: float | None = None
     chunk_len = int(chunks[0].shape[0]) if n else 0
 
-    def _wkey() -> tuple:
-        return (*warm_key, chunk_len, r.scorer)
+    def _wkey(width: int = 1) -> tuple:
+        """Warm-registry key, WIDTH-KEYED (regression-pinned): fused
+        measurements are normalized per chunk but lack the per-dispatch
+        host overhead an unfused chunk pays, so a K=8 group filed under
+        the K=1 key would deflate the per-chunk deadline estimate (and
+        vice versa inflate the fused one). Each fused width files and
+        reads its own entry."""
+        return (*warm_key, chunk_len, width, r.scorer)
 
     prior_s = _WARM_CHUNKS.get(_wkey())
+    # fused-mode measurement track (normalized per chunk, see _wkey)
+    mega_warm_s: float | None = None
+    mega_prior_s = _WARM_CHUNKS.get(_wkey(mega_k)) if mega_k > 1 else None
+    mega_active = False  # True while a fused walker owns the ladder
     handles: list = []  # per-retired-chunk async curve transfers
+
+    def _est_chunk_s() -> float | None:
+        """Per-chunk duration estimate for the deadline and
+        certificate gates. The fused walkers prefer their own
+        normalized measurements; the per-chunk walkers never see a
+        fused value (satellite-pinned — widths must not cross-feed)."""
+        cands = (
+            (mega_warm_s, mega_prior_s, last_chunk_s) if mega_active
+            else (warm_chunk_s, prior_s, last_chunk_s)
+        )
+        for v in cands:
+            if v is not None:
+                return v
+        return None
 
     # PRNG keys split up front, in exactly the order the sequential
     # loop used to split them — a speculatively dispatched chunk must
@@ -1137,6 +1348,7 @@ def _run_ladder(
                 steps_per_round, engine=engine, temps=chunks[i],
                 scorer=r.scorer, state=st,
             )
+        r.dispatches += 1
         if engine == "sweep":
             new_state, pop_a, pop_k, curve = out
         else:
@@ -1149,7 +1361,7 @@ def _run_ladder(
         return _is_pallas_lowering(e, r.scorer)
 
     def _note_fallback(i, e) -> None:
-        nonlocal warm_chunk_s, prior_s
+        nonlocal warm_chunk_s, prior_s, mega_warm_s, mega_prior_s
         _ladder.note_rung("pallas_to_xla", chunk=i)
         r.pallas_fallback = repr(e)[:500]
         r.scorer = "xla"
@@ -1159,6 +1371,10 @@ def _run_ladder(
         # the warm measurement and re-fetch the prior under the new key
         warm_chunk_s = None
         prior_s = _WARM_CHUNKS.get(_wkey())
+        mega_warm_s = None
+        mega_prior_s = (
+            _WARM_CHUNKS.get(_wkey(mega_k)) if mega_k > 1 else None
+        )
         _olog.warn("pallas_fallback", chunk=i, error=repr(e)[:200])
 
     def dispatch_or_fallback(i, st):
@@ -1239,11 +1455,7 @@ def _run_ladder(
         # and need no boundary host data until a check actually runs —
         # it skips even the device_get; the chain engine always needs
         # it for the reseed.)
-        est_chunk_s = (
-            warm_chunk_s if warm_chunk_s is not None
-            else (prior_s if prior_s is not None else last_chunk_s)
-        )
-        remaining_s = (n - i - 1) * (est_chunk_s or 0.0)
+        remaining_s = (n - i - 1) * (_est_chunk_s() or 0.0)
         do_cert = (
             not multi
             and remaining_s > cert_min_savings_s
@@ -1333,6 +1545,7 @@ def _run_ladder(
         nonlocal warm_chunk_s, last_chunk_s
         r.pop_a, r.pop_k = pop_a, pop_k
         r.rounds_run += int(chunks[i].shape[0])
+        r.chunks_exec += 1
         r.dispatch_s += disp_s
         r.device_s += device_s
         last_chunk_s = chunk_s
@@ -1345,12 +1558,14 @@ def _run_ladder(
         handles.append(h)
         return h
 
-    def run_sync():
+    def run_sync(start: int = 0):
         """One chunk at a time, fully retired before the next dispatch
         (the chain engine — its reseed is a data dependency — and the
-        ``--no-pipeline`` escape hatch)."""
+        ``--no-pipeline`` escape hatch). ``start`` > 0 is the fused
+        walkers' drain re-entry point: resume at the first chunk the
+        fused group did not finish."""
         nonlocal sweep_state
-        for i in range(n):
+        for i in range(start, n):
             dl = _deadline_now()
             if dl is not None and i >= 1:
                 est = warm_chunk_s if warm_chunk_s is not None else prior_s
@@ -1383,17 +1598,18 @@ def _run_ladder(
                 r.timed_out = i + 1 < n
                 return
 
-    def run_pipelined():
+    def run_pipelined(start: int = 0):
         """Double-buffered sweep dispatch: chunk i+1 enters the device
         queue before chunk i's results are waited on, so every piece of
         chunk i's boundary work (curve transfer, certificates,
         constructor adoption, checkpoint writes in the caller) executes
-        while the device is busy."""
+        while the device is busy. ``start`` > 0 resumes after a fused
+        group drained (megachunk_to_chunked)."""
         nonlocal sweep_state
         r.pipelined = True
         t_mark = time.perf_counter()
-        pending, pend_fb = dispatch_or_fallback(0, sweep_state)
-        i = 0
+        pending, pend_fb = dispatch_or_fallback(start, sweep_state)
+        i = start
         while True:
             new_state, pop_a, pop_k, curve, disp_s = pending
             # the scorer THIS chunk executed under: a failing
@@ -1465,13 +1681,337 @@ def _run_ladder(
                 pend_fb = True
             i += 1
 
+    # ---------------- fused megachunk walkers (mega_k > 1) ----------------
+
+    def _arm_exit(i) -> tuple | None:
+        """Device-side early-exit certificate arming — the exact mirror
+        of boundary()'s adaptive gate: arm only when skipping the
+        ladder past chunk ``i`` would save more than certification
+        costs, and the bounds are already in hand (never block on
+        them). Returns ``(cert_k, cert_mv)`` thresholds or None
+        (disarmed sentinels)."""
+        if multi or not bounds_fut.done():
+            return None
+        remaining_s = (n - i - 1) * (_est_chunk_s() or 0.0)
+        if remaining_s <= cert_min_savings_s:
+            return None
+        try:
+            lb_exact, ub0 = bounds_fut.result()
+        except Exception:
+            return None
+        return int(ub0), int(lb_exact)
+
+    def _mega_degradable(e) -> bool:
+        """Any fault inside a fused group drains to the per-chunk
+        dispatchers, which own the finer-grained recovery (the
+        Pallas→XLA retry, the host-fallback rung); sanitizer trips and
+        real regressions surface unchanged."""
+        return _degradable(e) or _is_lowering(e)
+
+    def dispatch_mega(i, k, st):
+        """Enqueue ONE fused group covering ``chunks[i:i+k]``. Groups
+        narrower than ``mega_k`` (the ladder tail, or a drain re-entry
+        remainder) pad with repeats of the last chunk under an inactive
+        mask — masked steps are inert no-ops, so the executable (keyed
+        on the stacked temps shape) never re-specializes on the tail.
+        Returns ``(out, armed, dispatch_s)``."""
+        _chaos_chunk_hooks()
+        _chaos.raise_if("megachunk_fault")
+        td = time.perf_counter()
+        group = list(chunks[i:i + k])
+        active = [True] * k + [False] * (mega_k - k)
+        while len(group) < mega_k:
+            group.append(group[-1])
+        arm = _arm_exit(i + k - 1)
+        cert_k, cert_mv = arm if arm is not None else (None, None)
+        fn = solve_lanes_megachunk if lanes else solve_megachunk
+        out = fn(
+            m, mesh, chains_per_device, jnp.stack(group), st,
+            active=np.asarray(active), cert_k=cert_k, cert_mv=cert_mv,
+            steps_per_round=steps_per_round, scorer=r.scorer,
+        )
+        r.dispatches += 1
+        return out, arm is not None, time.perf_counter() - td
+
+    def _read_exec(execd, k, armed) -> tuple:
+        """How many of the group's ``k`` real chunks executed, and
+        whether the scan exited early. A DISARMED group runs all ``k``
+        by construction, so the answer needs no device transfer and no
+        sync — the fused fast path stays one round-trip per group."""
+        if not armed:
+            return k, False
+        e = np.asarray(execd)
+        # replicated across shards (pmax) and lanes: row 0 suffices
+        n_exec = int(e.reshape(-1, e.shape[-1])[0][:k].sum())
+        return n_exec, n_exec < k
+
+    def _certify_exit(cert_a, cert_ok, cert_mvs) -> bool:
+        """Host-authoritative check of a device-flagged exit: the scan
+        body tested the pure threshold ``best_k >= ub0 and best_mv <=
+        lb_exact``; the host re-verifies the snapshot against the real
+        oracles (feasibility, exact move count, preservation weight,
+        one leader reseat) exactly like boundary() does. Returns True
+        when the certificate holds."""
+        nonlocal reseat_tries
+        ok, ca, mv = (
+            np.asarray(x)
+            for x in fetch_global((cert_ok, cert_a, cert_mvs))
+        )
+        if lanes:
+            ok = ok[:, :lanes].reshape(-1)
+            mv = mv[:, :lanes].reshape(-1)
+            ca = ca[:, :lanes].reshape(-1, *ca.shape[2:])
+        else:
+            ok, mv = ok.reshape(-1), mv.reshape(-1)
+            ca = ca.reshape(-1, *ca.shape[1:])
+        qual = [j for j in range(ok.shape[0]) if ok[j]]
+        if not qual:
+            return False
+        try:
+            lb_exact, ub0 = bounds_fut.result()
+        except Exception:
+            return False
+        # lowest-move-count qualifier first, top candidate only (the
+        # same single-candidate discipline as boundary())
+        for j in sorted(qual, key=lambda j: int(mv[j]))[:1]:
+            cand = arrays.unpad_candidate(ca[j], inst)
+            if not inst.is_feasible(cand):
+                continue
+            if inst.move_count(cand) > lb_exact:
+                continue
+            w_cand = inst.preservation_weight(cand)
+            if w_cand < ub0 and reseat_tries < 3:
+                reseat_tries += 1
+                cand = inst.best_leader_assignment(cand)
+                w_cand = inst.preservation_weight(cand)
+            if w_cand >= ub0:
+                r.certified_a = cand
+                if lanes:
+                    r.winner_lane = int(j % lanes)
+                r.certified_at_s = round(
+                    time.perf_counter() - budget.t0, 4
+                )
+                r.mega_early_exit = True
+                return True
+        return False
+
+    def retire_mega(i, k, out, disp_s, armed, group_s):
+        """Retire one fused group: sync, commit the carried state,
+        account the chunks that executed, and expand the group's single
+        curve transfer into per-chunk handles (_CurveSlice). Files the
+        warm estimate NORMALIZED per chunk under the fused width's own
+        registry key."""
+        nonlocal sweep_state, mega_warm_s, last_chunk_s
+        (new_state, pop_a, pop_k, cert_a, cert_ok, cert_mv,
+         curves, execd) = out
+        tw = time.perf_counter()
+        jax.block_until_ready(pop_a)
+        device_s = time.perf_counter() - tw
+        sweep_state = new_state
+        n_exec, early = _read_exec(execd, k, armed)
+        r.pop_a, r.pop_k = pop_a, pop_k
+        r.mega_groups += 1
+        r.chunks_exec += n_exec
+        r.dispatch_s += disp_s
+        r.device_s += device_s
+        h = fetch_global_async(curves)
+        ax = 2 if lanes else 1
+        for j in range(n_exec):
+            r.rounds_run += int(chunks[i + j].shape[0])
+            handles.append(_CurveSlice(h, j, ax))
+        per_chunk = group_s / max(1, n_exec)
+        last_chunk_s = per_chunk
+        if i > 0 and n_exec == k == mega_k:
+            # full-width group past the compile-inclusive first one
+            mega_warm_s = (
+                per_chunk if mega_warm_s is None
+                else min(mega_warm_s, per_chunk)
+            )
+        return (cert_a, cert_ok, cert_mv), n_exec, early, device_s
+
+    def mega_attrs(sp, k, n_exec, armed, early, disp_s,
+                   device_s) -> None:
+        if sp is None:
+            return
+        sp.set(width=k, executed=n_exec, armed=armed, early_exit=early,
+               dispatch_s=round(disp_s, 4), device_s=round(device_s, 4))
+
+    def _drain(i, e, to_pipelined: bool) -> None:
+        """Step down megachunk_to_chunked and re-enter the per-chunk
+        ladder at chunk ``i`` — the first chunk no fused group
+        finished. Width-keyed estimates mean the re-entry gates on the
+        unfused prior, untouched by the fused measurements."""
+        nonlocal mega_active
+        mega_active = False
+        _ladder.note_rung(
+            "megachunk_to_chunked", chunk=i,
+            **({"error": repr(e)[:200]} if e is not None
+               else {"reason": "exit_uncertified"}),
+        )
+        if to_pipelined:
+            run_pipelined(start=i)
+        else:
+            run_sync(start=i)
+
+    def run_mega_sync():
+        """Fused dispatcher, one group at a time: dispatch K chunks,
+        sync, boundary — the per-chunk ladder's loop shape at 1/K the
+        host round-trips."""
+        nonlocal mega_active
+        mega_active = True
+        r.mega_k = mega_k
+        i = 0
+        while i < n:
+            k = min(mega_k, n - i)
+            dl = _deadline_now()
+            if dl is not None and i >= 1:
+                est = _est_chunk_s()
+                if time.perf_counter() > dl or (
+                    est is not None
+                    and dl - time.perf_counter() < est * k * 0.9
+                ):
+                    r.timed_out = True
+                    return
+            with _otrace.span("megachunk", index=r.mega_groups,
+                              first_chunk=i, width=k) as _sp:
+                tg = time.perf_counter()
+                try:
+                    out, armed, disp_s = dispatch_mega(i, k, sweep_state)
+                    certs, n_exec, early, device_s = retire_mega(
+                        i, k, out, disp_s, armed,
+                        time.perf_counter() - tg,
+                    )
+                except Exception as e:
+                    if (not _mega_degradable(e)
+                            or not _leaves_alive(sweep_state)):
+                        raise
+                    _drain(i, e, to_pipelined=False)
+                    return
+                mega_attrs(_sp, k, n_exec, armed, early, disp_s,
+                           device_s)
+            if early:
+                if _certify_exit(*certs):
+                    return
+                # the device flagged an exit the host could not
+                # certify: the remaining fused groups would flag again
+                # every step, so hand the tail to the per-chunk ladder
+                # (whose boundary certificates carry the reseat/tight
+                # tiers) from the first unexecuted chunk
+                _drain(i + n_exec, None, to_pipelined=False)
+                return
+            if boundary(i + k - 1):
+                return
+            dl = _deadline_now()
+            if dl is not None and time.perf_counter() > dl:
+                r.timed_out = i + k < n
+                return
+            i += k
+
+    def run_mega_pipelined():
+        """Double-buffered fused dispatch: group g+1 enters the device
+        queue before group g is waited on, so group g's boundary work
+        (curve transfer, certificates, constructor adoption) overlaps
+        K chunks of device time instead of one.
+
+        Early-exit corner (documented in docs/PIPELINE.md): when group
+        g exits early while group g+1 is already in flight, g+1's input
+        state was donated at dispatch — there is no live buffer to
+        resume from the exact exit point. A certificate that HOLDS
+        makes this moot (the speculation is abandoned unread, as the
+        per-chunk pipeline abandons its in-flight chunk). A certificate
+        that FAILS host-side adopts the in-flight group (its trajectory
+        is the full-K continuation — schedule-gap-free relative to its
+        own input) and then drains to the per-chunk ladder."""
+        nonlocal mega_active
+        mega_active = True
+        r.mega_k = mega_k
+        r.pipelined = True
+        t_mark = time.perf_counter()
+        i = 0
+        k = min(mega_k, n)
+        try:
+            pending = dispatch_mega(i, k, sweep_state)
+        except Exception as e:
+            if (not _mega_degradable(e)
+                    or not _leaves_alive(sweep_state)):
+                raise
+            _drain(i, e, to_pipelined=True)
+            return
+        while True:
+            out, armed, disp_s = pending
+            new_state = out[0]
+            j, k_next = i + k, min(mega_k, n - i - k)
+            nxt, drain_exc = None, None
+            if k_next > 0:
+                try:
+                    nxt = dispatch_mega(j, k_next, new_state)
+                except Exception as e:
+                    if (not _mega_degradable(e)
+                            or not _leaves_alive(new_state)):
+                        raise
+                    drain_exc = e
+            with _otrace.span("megachunk", index=r.mega_groups,
+                              first_chunk=i, width=k) as _sp:
+                now = time.perf_counter()
+                certs, n_exec, early, device_s = retire_mega(
+                    i, k, out, disp_s, armed, now - t_mark,
+                )
+                t_mark = time.perf_counter()
+                tb = time.perf_counter()
+                stop = early or boundary(i + k - 1)
+                if nxt is not None:
+                    r.boundary_overlap_s += time.perf_counter() - tb
+                mega_attrs(_sp, k, n_exec, armed, early, disp_s,
+                           device_s)
+            if early:
+                if _certify_exit(*certs):
+                    return  # in-flight speculation abandoned unread
+                if nxt is not None:
+                    # adopt the in-flight group, then hand the tail to
+                    # the per-chunk ladder (see docstring corner)
+                    out2, armed2, disp2 = nxt
+                    certs2, n2, early2, _dev2 = retire_mega(
+                        j, k_next, out2, disp2, armed2,
+                        time.perf_counter() - t_mark,
+                    )
+                    t_mark = time.perf_counter()
+                    if early2 and _certify_exit(*certs2):
+                        return
+                    _drain(j + n2, None, to_pipelined=True)
+                    return
+                _drain(i + n_exec, None, to_pipelined=True)
+                return
+            if stop or k_next <= 0:
+                return
+            dl = _deadline_now()
+            if dl is not None:
+                nowd = time.perf_counter()
+                est = _est_chunk_s()
+                if nowd > dl or (
+                    est is not None and dl - nowd < est * k_next * 0.9
+                ):
+                    r.timed_out = True
+                    return
+            if drain_exc is not None:
+                _drain(j, drain_exc, to_pipelined=True)
+                return
+            pending = nxt
+            i, k = j, k_next
+
     prof = (
         jax.profiler.trace(profile_dir)  # SURVEY.md §5 tracing/profiling
         if profile_dir
         else contextlib.nullcontext()
     )
     with prof:
-        if pipeline and engine == "sweep" and n > 1:
+        if engine == "sweep" and mega_k > 1 and n > 1:
+            # fused megachunk ladder; faults drain into the per-chunk
+            # walkers below via megachunk_to_chunked
+            if pipeline:
+                run_mega_pipelined()
+            else:
+                run_mega_sync()
+        elif pipeline and engine == "sweep" and n > 1:
             run_pipelined()
         else:
             run_sync()
@@ -1481,6 +2021,8 @@ def _run_ladder(
     r.curves = [np.asarray(h.get()) for h in handles]
     if warm_chunk_s is not None:
         _WARM_CHUNKS.update(_wkey(), warm_chunk_s)
+    if mega_warm_s is not None:
+        _WARM_CHUNKS.update(_wkey(mega_k), mega_warm_s)
     return r
 
 
@@ -1815,7 +2357,7 @@ def _solve_tpu_inner(
     backend_fut, t0, bounds_fut, cert_min_savings_s=1.0,
     lp_fut=None, multi=False, lp_wait_s=_CONSTRUCT_WAIT_S,
     pipeline=True, budget: Budget | None = None, warm_start=None,
-    portfolio=None,
+    portfolio=None, megachunk=None,
 ) -> SolveResult:
     timed_out = False
     early_stopped = False
@@ -2071,6 +2613,19 @@ def _solve_tpu_inner(
         # geometry even when the ladder span is the one timed
         if port_lanes:
             _otrace.mark("portfolio", width=pw, lane_bucket=port_lanes)
+        # fused megachunk width (ISSUE 17): resolved per BUCKET — the
+        # evidence key is the warm-chunk identity, so "auto" tunes K
+        # from this executable family's own measured host/device split
+        if engine == "sweep":
+            from . import sweep as _sweep_mod
+
+            _mega_sup = getattr(_sweep_mod, "SUPPORTS_MEGACHUNK", False)
+        else:
+            _mega_sup = False
+        mega_k, mega_mode = _resolve_megachunk(
+            megachunk, _mega_sup, multi, len(chunks),
+            (*warm_key, int(chunks[0].shape[0]), scorer),
+        )
         with _otrace.span("ladder", engine=engine,
                           chunks=len(chunks)) as _sp:
             lad = _run_ladder(
@@ -2080,6 +2635,7 @@ def _solve_tpu_inner(
                 cert_min_savings_s, budget, profile_dir,
                 polish_starter=_start_polish_aot, pipeline=pipeline,
                 warm_key=warm_key, lanes=pw if port_lanes else 0,
+                mega_k=mega_k,
             )
             if _sp is not None:
                 _sp.set(rounds_run=lad.rounds_run,
@@ -2090,12 +2646,24 @@ def _solve_tpu_inner(
                         boundary_overlap_s=round(
                             lad.boundary_overlap_s, 4),
                         boundary_certified=lad.certified_a is not None,
-                        portfolio_width=pw if port_lanes else None)
+                        portfolio_width=pw if port_lanes else None,
+                        dispatches=lad.dispatches,
+                        megachunk_k=lad.mega_k)
+        if engine == "sweep" and lad.dispatches:
+            # feed the fusion evidence table (KAO_MEGACHUNK=auto):
+            # per-dispatch host overhead vs per-chunk device time for
+            # this executable family — K=1 solves teach it too
+            note_megachunk_evidence(
+                (*warm_key, int(chunks[0].shape[0]), lad.scorer),
+                dispatches=lad.dispatches, dispatch_s=lad.dispatch_s,
+                chunks=lad.chunks_exec, device_s=lad.device_s,
+            )
     else:
         # constructed fast path: the ladder never runs, and calling into
         # it would import device-adjacent modules this path avoids
         _otrace.mark("ladder", skipped=True)
         lad = _LadderResult(scorer=scorer)
+        mega_mode = "off"
     polish_fut = polish_fut_box[0] if polish_fut_box else None
     pop_a, pop_k = lad.pop_a, lad.pop_k
     scorer, pallas_fallback = lad.scorer, lad.pallas_fallback
@@ -2289,6 +2857,20 @@ def _solve_tpu_inner(
             "dispatch_s": round(lad.dispatch_s, 4),
             "device_s": round(lad.device_s, 4),
             "boundary_overlap_s": round(lad.boundary_overlap_s, 4),
+            # device dispatches the ladder issued (fused or not) — the
+            # megachunk headline metric is this divided by chunks run
+            "dispatches": lad.dispatches,
+            # fused-ladder provenance (ISSUE 17, docs/PIPELINE.md):
+            # resolved width, how it was chosen, group/chunk counts,
+            # and whether an on-device certificate retired the scan
+            **({"megachunk": {
+                "k": lad.mega_k,
+                "mode": mega_mode,
+                "groups": lad.mega_groups,
+                "dispatches": lad.dispatches,
+                "chunks": lad.chunks_exec,
+                "early_exit": lad.mega_early_exit,
+            }} if engine == "sweep" and chunks else {}),
             **({"pallas_fallback": pallas_fallback} if pallas_fallback
                else {}),
             # portfolio provenance (docs/PORTFOLIO.md): the racing
@@ -2419,6 +3001,7 @@ def _solve_tpu_batch_impl(
     trace: bool | str | None = None,
     pipeline: bool | None = None,
     portfolio: bool | int | None = None,
+    megachunk: "bool | int | str | None" = None,
     precompile: bool = False,  # consumed by the solve_tpu_batch wrapper
 ) -> list[SolveResult]:
     """Solve L independent instances in ONE batched device dispatch —
@@ -2506,7 +3089,8 @@ def _solve_tpu_batch_impl(
                                       t_lo=t_lo, n_devices=n_devices,
                                       time_limit_s=time_limit_s,
                                       pipeline=pipeline,
-                                      portfolio=portfolio)
+                                      portfolio=portfolio,
+                                      megachunk=megachunk)
                 if lane_rungs:
                     r.stats["degradations"] = list(lane_rungs)
                 r.stats["lane_fallback"] = (
@@ -2527,6 +3111,7 @@ def _solve_tpu_batch_impl(
                 n_devices, time_limit_s, certify, t0, L,
                 fetch_global, make_mesh, solve_lanes,
                 enable_compile_cache, ensure_backend, bucket, pipeline,
+                megachunk,
             )
     except BaseException as e:
         if isinstance(e, FloatingPointError):
@@ -2552,6 +3137,7 @@ def _solve_batch_body(
     insts, seeds, engine, batch, rounds, sweeps, t_hi, t_lo, n_devices,
     time_limit_s, certify, t0, L, fetch_global, make_mesh, solve_lanes,
     enable_compile_cache, ensure_backend, bucket, pipeline=True,
+    megachunk=None,
 ) -> list[SolveResult]:
     for inst in insts:
         inst._bounds_cancelled = False
@@ -2647,7 +3233,9 @@ def _solve_batch_body(
     # state, so a chunked schedule is bit-identical to the uncut one;
     # the chain engine reseeds each lane from its best-so-far at the
     # boundary, exactly like the single path's reseed)
-    from ...parallel.mesh import fetch_global_async
+    from ...parallel.mesh import (
+        fetch_global_async, init_lane_state, solve_lanes_megachunk,
+    )
 
     deadline = Budget(time_limit_s, t0=t0).deadline
     chunks = _build_chunks(biggest, engine, rounds, t_hi, t_lo,
@@ -2673,15 +3261,45 @@ def _solve_batch_body(
     warm_key = ("lanes", Lp, engine, n_dev, chains_per_device,
                 steps_per_round, int(bkt_parts), int(bkt_rf))
 
-    def _wkey():
-        return (*warm_key, chunk_len, scorer)
+    def _wkey(width: int = 1):
+        # width-keyed like the single path's registry: fused and
+        # unfused measurements must never cross-feed (regression-pinned)
+        return (*warm_key, chunk_len, width, scorer)
 
     prior_s = _WARM_CHUNKS.get(_wkey())
+
+    # fused megachunk width (ISSUE 17): batch lanes are independent
+    # instances, so fused groups always run DISARMED — no shared early
+    # exit — and the fusion saves dispatches/host round-trips only
+    if engine == "sweep":
+        from . import sweep as _sweep_mod
+
+        _mega_sup = getattr(_sweep_mod, "SUPPORTS_MEGACHUNK", False)
+    else:
+        _mega_sup = False
+    mega_k, mega_mode = _resolve_megachunk(
+        megachunk, _mega_sup, False, n,
+        (*warm_key, chunk_len, scorer),
+    )
+    mega_warm_s: float | None = None
+    mega_prior_s = _WARM_CHUNKS.get(_wkey(mega_k)) if mega_k > 1 else None
+    mega_groups = 0
+    dispatches = 0
+    chunks_exec = 0
+    dispatch_s_total = 0.0
+    device_s_total = 0.0
+
+    def _mega_est():
+        for v in (mega_warm_s, mega_prior_s):
+            if v is not None:
+                return v
+        return None
 
     def dispatch(ci, st):
         """Enqueue chunk ci (no wait); timed internally so a fallback
         retry times the successful dispatch only. Same chaos points as
         the single path (_chaos_chunk_hooks: host side, never traced)."""
+        nonlocal dispatches
         _chaos_chunk_hooks()
         td = time.perf_counter()
         out = solve_lanes(
@@ -2689,6 +3307,7 @@ def _solve_batch_body(
             lane_seeds=cur_seeds, keys=cur_keys, engine=engine,
             steps_per_round=steps_per_round, scorer=scorer,
         )
+        dispatches += 1
         if engine == "sweep":
             new_state, pa, pk, cv = out
         else:
@@ -2724,8 +3343,12 @@ def _solve_batch_body(
     def retire(ci, pa, pk, cv, disp_s, device_s, chunk_s, fb, sp,
                overlap_s, scorer_ran=None):
         nonlocal pop_a, pop_k, rounds_run, warm_chunk_s
+        nonlocal chunks_exec, dispatch_s_total, device_s_total
         pop_a, pop_k = pa, pk
         rounds_run += int(chunks[ci].shape[0])
+        chunks_exec += 1
+        dispatch_s_total += disp_s
+        device_s_total += device_s
         handles.append(fetch_global_async(cv))
         if ci > 0 and not fb:
             warm_chunk_s = (
@@ -2741,9 +3364,9 @@ def _solve_batch_body(
                    device_s=round(device_s, 4),
                    boundary_overlap_s=round(overlap_s, 4))
 
-    def run_sync():
+    def run_sync(start: int = 0):
         nonlocal state, cur_seeds, cur_keys, timed_out
-        for ci in range(n):
+        for ci in range(start, n):
             if deadline is not None and ci >= 1:
                 est = (warm_chunk_s if warm_chunk_s is not None
                        else prior_s)
@@ -2781,16 +3404,17 @@ def _solve_batch_body(
                 timed_out = ci + 1 < n
                 return
 
-    def run_pipelined():
+    def run_pipelined(start: int = 0):
         """Sweep lanes, double-buffered: chunk ci+1 enters the device
         queue before chunk ci's results are waited on — same dispatch
         discipline as the single path (docs/PIPELINE.md); the per-lane
-        state is donated, so each chunk updates HBM in place."""
+        state is donated, so each chunk updates HBM in place. ``start``
+        > 0 is the fused walkers' drain re-entry point."""
         nonlocal state, timed_out, pipelined
         pipelined = True
         t_mark = time.perf_counter()
-        pending, pend_fb = dispatch_or_fallback(0, state)
-        ci = 0
+        pending, pend_fb = dispatch_or_fallback(start, state)
+        ci = start
         while True:
             new_state, pa, pk, cv, disp_s = pending
             ran_scorer = scorer  # before a speculation failure flips it
@@ -2845,19 +3469,181 @@ def _solve_batch_body(
                 pend_fb = True
             ci += 1
 
+    # ------------- fused megachunk walkers (mega_k > 1, sweep) -------------
+    # Independent lanes never share an early exit, so batch groups run
+    # DISARMED: every group executes all its chunks, no device transfer
+    # decides anything, and the fusion saves host round-trips only.
+
+    def dispatch_mega(ci, k, st):
+        nonlocal dispatches
+        _chaos_chunk_hooks()
+        _chaos.raise_if("megachunk_fault")
+        td = time.perf_counter()
+        group = list(chunks[ci:ci + k])
+        active = [True] * k + [False] * (mega_k - k)
+        while len(group) < mega_k:
+            group.append(group[-1])
+        out = solve_lanes_megachunk(
+            m_stack, mesh, chains_per_device, jnp.stack(group), st,
+            active=np.asarray(active), steps_per_round=steps_per_round,
+            scorer=scorer,
+        )
+        dispatches += 1
+        return out, time.perf_counter() - td
+
+    def retire_mega(ci, k, out, disp_s, group_s, sp):
+        nonlocal state, pop_a, pop_k, rounds_run, mega_warm_s
+        nonlocal mega_groups, chunks_exec, dispatch_s_total
+        nonlocal device_s_total
+        (new_state, pa, pk, _ca, _cok, _cmv, cv, _ex) = out
+        tw = time.perf_counter()
+        jax.block_until_ready(pa)
+        device_s = time.perf_counter() - tw
+        state = new_state
+        pop_a, pop_k = pa, pk
+        mega_groups += 1
+        chunks_exec += k
+        dispatch_s_total += disp_s
+        device_s_total += device_s
+        h = fetch_global_async(cv)
+        for j in range(k):
+            rounds_run += int(chunks[ci + j].shape[0])
+            handles.append(_CurveSlice(h, j, 2))  # [n_dev, L, K, c]
+        per_chunk = group_s / max(1, k)
+        if ci > 0 and k == mega_k:
+            mega_warm_s = (
+                per_chunk if mega_warm_s is None
+                else min(mega_warm_s, per_chunk)
+            )
+        if sp is not None:
+            sp.set(width=k, dispatch_s=round(disp_s, 4),
+                   device_s=round(device_s, 4))
+
+    def _mega_degradable(e) -> bool:
+        return _degradable(e) or _is_lowering(e)
+
+    def _drain(ci, e) -> None:
+        """megachunk_to_chunked: re-enter the per-chunk batch ladder at
+        the first chunk no fused group finished."""
+        _ladder.note_rung("megachunk_to_chunked", chunk=ci,
+                          error=repr(e)[:200])
+        if pipeline:
+            run_pipelined(start=ci)
+        else:
+            run_sync(start=ci)
+
+    def run_mega_sync():
+        nonlocal timed_out
+        ci = 0
+        while ci < n:
+            k = min(mega_k, n - ci)
+            if deadline is not None and ci >= 1:
+                est = _mega_est()
+                if est is not None and (
+                    deadline - time.perf_counter() < est * k * 0.9
+                ):
+                    timed_out = True
+                    return
+            with _otrace.span("megachunk", index=mega_groups,
+                              first_chunk=ci, width=k) as _sp:
+                tg = time.perf_counter()
+                try:
+                    out, disp_s = dispatch_mega(ci, k, state)
+                    retire_mega(ci, k, out, disp_s,
+                                time.perf_counter() - tg, _sp)
+                except Exception as e:
+                    if (not _mega_degradable(e)
+                            or not _leaves_alive(state)):
+                        raise
+                    _drain(ci, e)
+                    return
+            if deadline is not None and time.perf_counter() > deadline:
+                timed_out = ci + k < n
+                return
+            ci += k
+
+    def run_mega_pipelined():
+        nonlocal timed_out, pipelined
+        pipelined = True
+        t_mark = time.perf_counter()
+        ci, k = 0, min(mega_k, n)
+        try:
+            pending = dispatch_mega(ci, k, state)
+        except Exception as e:
+            if not _mega_degradable(e) or not _leaves_alive(state):
+                raise
+            _drain(ci, e)
+            return
+        while True:
+            out, disp_s = pending
+            new_state = out[0]
+            cj, k_next = ci + k, min(mega_k, n - ci - k)
+            nxt, drain_exc = None, None
+            if k_next > 0:
+                try:
+                    nxt = dispatch_mega(cj, k_next, new_state)
+                except Exception as e:
+                    if (not _mega_degradable(e)
+                            or not _leaves_alive(new_state)):
+                        raise
+                    drain_exc = e
+            with _otrace.span("megachunk", index=mega_groups,
+                              first_chunk=ci, width=k) as _sp:
+                retire_mega(ci, k, out, disp_s,
+                            time.perf_counter() - t_mark, _sp)
+                t_mark = time.perf_counter()
+            if k_next <= 0:
+                return
+            if deadline is not None:
+                nowd = time.perf_counter()
+                est = _mega_est()
+                if nowd > deadline or (
+                    est is not None
+                    and deadline - nowd < est * k_next * 0.9
+                ):
+                    timed_out = True
+                    return
+            if drain_exc is not None:
+                _drain(cj, drain_exc)
+                return
+            pending = nxt
+            ci, k = cj, k_next
+
     with _otrace.span("ladder", engine=engine,
                       chunks=len(chunks)) as _lsp:
-        if pipeline and engine == "sweep" and n > 1:
+        if engine == "sweep" and mega_k > 1 and n > 1:
+            if state is None:
+                # the per-chunk path lets solve_lanes build this from
+                # (lane_seeds, keys) on first dispatch; the fused
+                # dispatchers take state only — same init, same values
+                state = init_lane_state(
+                    m_stack, cur_seeds, cur_keys, mesh,
+                    chains_per_device,
+                )
+            if pipeline:
+                run_mega_pipelined()
+            else:
+                run_mega_sync()
+        elif pipeline and engine == "sweep" and n > 1:
             run_pipelined()
         else:
             run_sync()
         if _lsp is not None:
             _lsp.set(rounds_run=rounds_run, timed_out=timed_out,
-                     scorer=scorer, pipelined=pipelined)
+                     scorer=scorer, pipelined=pipelined,
+                     dispatches=dispatches, megachunk_k=mega_k)
     if timed_out:
         _ladder.note_rung("deadline_truncated", rounds_run=rounds_run)
     if warm_chunk_s is not None:
         _WARM_CHUNKS.update(_wkey(), warm_chunk_s)
+    if mega_warm_s is not None:
+        _WARM_CHUNKS.update(_wkey(mega_k), mega_warm_s)
+    if engine == "sweep" and dispatches:
+        note_megachunk_evidence(
+            (*warm_key, chunk_len, scorer),
+            dispatches=dispatches, dispatch_s=dispatch_s_total,
+            chunks=chunks_exec, device_s=device_s_total,
+        )
     t_solve = time.perf_counter()
 
     # per-lane final selection on the host: rank each lane's per-shard
@@ -2874,6 +3660,11 @@ def _solve_batch_body(
             platform, engine, L, chains_per_device, rounds, rounds_run,
             timed_out, bkt_parts, bkt_rf, scorer, pallas_fallback,
             time_limit_s, seed_moves, pipelined, lane_bucket=Lp,
+            dispatches=dispatches,
+            mega={"k": mega_k, "mode": mega_mode, "groups": mega_groups,
+                  "dispatches": dispatches, "chunks": chunks_exec,
+                  "early_exit": False} if engine == "sweep" and n
+            else None,
         )
         if _vsp is not None:
             _vsp.set(lanes_feasible=sum(
@@ -2885,7 +3676,7 @@ def _select_lanes(
     insts, pa, curve_np, n_dev, certify, wall, t_solve, t0, platform,
     engine, L, chains_per_device, rounds, rounds_run, timed_out,
     bkt_parts, bkt_rf, scorer, pallas_fallback, time_limit_s, seed_moves,
-    pipelined=False, lane_bucket=None,
+    pipelined=False, lane_bucket=None, dispatches=None, mega=None,
 ) -> list[SolveResult]:
     """Per-lane final selection + oracle verification (the batch path's
     "verify" phase body). Iterates the REAL instances only — this loop
@@ -2931,6 +3722,12 @@ def _select_lanes(
                 "bucket_rf": int(bkt_rf),
                 "scorer": scorer,
                 "pipeline": pipelined,
+                # shared-dispatch accounting: the batch's ONE ladder
+                # served every lane, so these columns describe the
+                # batch dispatch, not this lane alone
+                **({"dispatches": int(dispatches)}
+                   if dispatches is not None else {}),
+                **({"megachunk": dict(mega)} if mega else {}),
                 **({"pallas_fallback": pallas_fallback}
                    if pallas_fallback else {}),
                 "proved_optimal": proved,

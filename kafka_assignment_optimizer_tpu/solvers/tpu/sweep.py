@@ -43,6 +43,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, random
 
 from ...ops.score import moves_batch
@@ -1309,3 +1310,136 @@ def make_sweep_stepper_fn(
         )
 
     return solve
+
+
+# Megachunk fusion (docs/PIPELINE.md): True here and False in
+# ``anneal.SUPPORTS_MEGACHUNK`` — the engine resolver consults the
+# flag instead of hard-coding engine names.
+SUPPORTS_MEGACHUNK = True
+
+# Never-fires early-exit sentinels: a chain qualifies when ``best_k >=
+# cert_k AND best_mv <= cert_mv``; no feasible key reaches int32 max
+# and no move count is negative, so disarmed groups pass these and the
+# armed/disarmed split never forks the executable (runtime scalars,
+# one signature).
+MEGA_DISARMED_KEY = np.int32(np.iinfo(np.int32).max)
+MEGA_DISARMED_MOVES = np.int32(-1)
+
+
+def make_mega_stepper_fn(
+    n_chains: int,
+    snapshot_every: int = 8,
+    axis_name: str | None = None,
+    scorer: str = "xla",
+    lane_axis: str | None = None,
+):
+    """Fuse K consecutive chunk steps into ONE device-resident scan:
+    ``(m, state, temps [K, c], active [K] bool, cert_k, cert_mv) ->
+    (state', top_a, top_k, cert_a, cert_ok, cert_mv_out, curves [K, c],
+    execd [K] bool)``. Each scan step invokes the UNCHANGED
+    :func:`make_sweep_stepper_fn` body on the carried state, so an
+    executed step is bit-identical to one dispatched chunk — the fused
+    run replays the exact accept/decline sequence of the K=1 path and
+    the carried state at every step boundary equals the state a chunked
+    run would have checkpointed there (pinned in
+    tests/test_megachunk_parity.py).
+
+    Early exit: after each step, a chain *qualifies* when ``best_k >=
+    cert_k and best_mv <= cert_mv`` — the device-side mirror of the
+    engine's boundary-certificate precheck (weight at the proved upper
+    bound, moves at the exact lower bound; the host still runs the
+    authoritative exact check on ``cert_a``). Any qualifying chain
+    anywhere (``lax.pmax`` over the mesh axis, and over the lane axis
+    for the vmapped form) sets a carried ``done`` flag and the
+    remaining steps become masked no-ops — the PR 1 inert-row
+    discipline applied to whole chunks. Disarmed callers pass the
+    never-fires sentinels ``cert_k = int32 max, cert_mv = -1`` so ONE
+    executable serves armed and disarmed groups. ``active`` masks tail
+    steps the same way (a group shorter than K pads ``temps`` and
+    clears ``active``), keeping one executable per (bucket, K).
+
+    The host reads ``execd`` to learn how many steps really ran and
+    expands ``curves`` back into per-chunk score curves; skipped steps
+    emit zero curves that the host discards. ``cert_a`` is this shard's
+    best qualifying snapshot (``cert_mv_out`` its move count, int32 max
+    when none) — under migration the qualifying chain may live on any
+    shard, so the host picks across shards before certifying. Donation
+    contract unchanged: every ``state`` leaf has an identically
+    shaped/dtyped leaf in ``state'``.
+
+    KAO113 guards the scan body: no host-sync primitive (``.item()``,
+    ``device_get``/``np.asarray`` on traced values, Python branches on
+    the carry) may appear here — each would force the host round-trip
+    this fusion exists to delete."""
+    chunk = make_sweep_stepper_fn(n_chains, snapshot_every, axis_name,
+                                  scorer)
+    imax = jnp.iinfo(jnp.int32).max
+
+    def solve(m: ModelArrays, state, temps: jax.Array,
+              active: jax.Array, cert_k: jax.Array, cert_mv: jax.Array):
+        def qualify(best_k, best_mv):
+            return jnp.logical_and(best_k >= cert_k, best_mv <= cert_mv)
+
+        def body(carry, xs):
+            st, done = carry
+            temps_j, active_j = xs
+            run = jnp.logical_and(active_j, jnp.logical_not(done))
+
+            def go(st):
+                st2, _top_a, _top_k, curve = chunk(m, st, temps_j)
+                return st2, curve
+
+            def skip(st):
+                return st, jnp.zeros((temps_j.shape[0],), jnp.int32)
+
+            st, curve = lax.cond(run, go, skip, st)
+            _a, best_k, best_mv, _best_a, _key = st
+            hit = jnp.any(qualify(best_k, best_mv)).astype(jnp.int32)
+            if axis_name is not None:
+                hit = lax.pmax(hit, axis_name)
+            if lane_axis is not None:
+                hit = lax.pmax(hit, lane_axis)
+            done = jnp.logical_or(done, hit > 0)
+            return (st, done), (curve, run)
+
+        (state, _done), (curves, execd) = lax.scan(
+            body, (state, jnp.asarray(False)), (temps, active)
+        )
+        a, best_k, best_mv, best_a, key = state
+        tied = best_k == jnp.max(best_k)
+        top = jnp.argmin(jnp.where(tied, best_mv, imax))
+        qual = qualify(best_k, best_mv)
+        cert_ok = jnp.any(qual)
+        cidx = jnp.argmin(jnp.where(qual, best_mv, imax))
+        cert_a = best_a[cidx]
+        cert_mv_out = jnp.where(cert_ok, best_mv[cidx], imax)
+        return (
+            (a, best_k, best_mv, best_a, key),
+            best_a[top], best_k[top], cert_a, cert_ok, cert_mv_out,
+            curves, execd,
+        )
+
+    return solve
+
+
+def make_mega_lane_stepper_fn(
+    n_chains: int,
+    snapshot_every: int = 8,
+    axis_name: str | None = None,
+    scorer: str = "xla",
+):
+    """Lane-batched :func:`make_mega_stepper_fn` — ``jax.vmap`` over
+    the lane axis exactly as :func:`make_lane_stepper_fn` wraps the
+    chunk stepper, so a lane's fused trajectory is bit-identical to
+    solving it alone. The vmap carries ``axis_name=\"lanes\"`` so the
+    early-exit ``pmax`` also spans lanes: in portfolio mode ANY lane
+    certifying stops every lane (first-to-certify, PR 11). Under vmap
+    the per-step ``lax.cond`` lowers to a select (both branches
+    execute), so lanes save dispatches and host round-trips but not
+    per-lane device compute after an exit — documented in
+    docs/PIPELINE.md. Batch-mode callers always pass the disarmed
+    sentinels (independent instances must not share an exit)."""
+    solve = make_mega_stepper_fn(n_chains, snapshot_every, axis_name,
+                                 scorer, lane_axis="lanes")
+    return jax.vmap(solve, in_axes=(0, 0, None, None, None, None),
+                    axis_name="lanes")

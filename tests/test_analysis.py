@@ -477,6 +477,75 @@ def test_kao112_partition_loop_in_decompose_modules():
     assert _rules(_lint(sup, rel="decompose/split.py")) == []
 
 
+# ---------------------------------------------------------------- KAO113
+
+POS_113_ITEM = """
+    from jax import lax
+
+    def sweep(state, temps):
+        def body(carry, temp):
+            carry, best = step(carry, temp)
+            done = best.item() > 0  # host sync inside the fused scan
+            return carry, done
+        return lax.scan(body, state, temps)
+"""
+
+POS_113_ASARRAY = """
+    import numpy as np
+    from jax import lax
+
+    def sweep(state, temps):
+        def body(carry, temp):
+            carry = step(carry, temp)
+            snap = np.asarray(carry[0])  # concretizes a tracer
+            return carry, snap
+        return lax.scan(body, state, temps)
+"""
+
+POS_113_BRANCH = """
+    from jax import lax
+
+    def sweep(state, temps):
+        def body(carry, temp):
+            if carry:  # Python branch on the traced carry
+                carry = step(carry, temp)
+            return carry, None
+        return lax.scan(body, state, temps)
+"""
+
+NEG_113_DEVICE_RESIDENT = """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+
+    def sweep(state, temps):
+        def body(carry, temp):
+            new, hit = step(carry, temp)
+            # masked no-op early exit: the decision stays on-device
+            carry = jnp.where(hit, carry, new)
+            ok = jnp.asarray(hit)  # jnp stays legal inside the body
+            return carry, ok
+        out, execd = lax.scan(body, state, temps)
+        return np.asarray(execd)  # host fetch AFTER the scan: fine
+"""
+
+
+def test_kao113_host_sync_in_scan_body():
+    assert "KAO113" in _rules(_lint(POS_113_ITEM))
+    assert "KAO113" in _rules(_lint(POS_113_ASARRAY))
+    assert "KAO113" in _rules(_lint(POS_113_BRANCH))
+    # the sanctioned megachunk shape: where-selects on the carry,
+    # jnp inside the body, host fetches only after the scan retires
+    assert "KAO113" not in _rules(_lint(NEG_113_DEVICE_RESIDENT))
+    # suppressible with justification, like every rule
+    sup = POS_113_ITEM.replace(
+        "done = best.item() > 0  # host sync inside the fused scan",
+        "done = best.item() > 0  "
+        "# kao: disable=KAO113 -- interpret-mode debug helper",
+    )
+    assert "KAO113" not in _rules(_lint(sup))
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

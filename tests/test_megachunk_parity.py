@@ -1,0 +1,307 @@
+"""Fused ladder megachunk bit-parity (ISSUE 17, docs/PIPELINE.md).
+
+A megachunk stacks K consecutive sweep chunks into one device-resident
+``lax.scan`` dispatch. Fusion is pure scheduling: the scan body is the
+per-chunk step, the carried state (population, best snapshots, PRNG
+keys) is the same state the chunked ladder hands between dispatches, so
+every fused width must reproduce the K=1 chunked trajectory BIT FOR BIT
+— final plan, curve, move count, checkpoint contents — while issuing
+~K× fewer dispatches. These tests pin that contract at the optimize
+level (XLA scorer), at the mesh level (XLA and Pallas-interpret, the
+code path TPU compiles via Mosaic), across a checkpoint-resume, through
+the device-side early-exit certificate, and through executable-cache
+warmth (a re-solve at the same (bucket, K) compiles nothing).
+
+Boundary/early-exit certificates are pinned OFF (``cert_min_savings_s=
+1e9``) in the strict-parity tests and ON (negative threshold) only in
+the early-exit tests, for the reasons test_pipeline_parity.py's module
+docstring gives: whether a certificate check runs is wall-clock
+adaptive by design.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.api import optimize
+from kafka_assignment_optimizer_tpu.models.cluster import (
+    Assignment,
+    PartitionAssignment,
+    Topology,
+)
+
+NO_DEADLINE = 3600.0
+
+
+def random_cluster(rng, n_brokers, n_parts, rf, n_racks, drop=0):
+    parts = []
+    for p in range(n_parts):
+        reps = rng.choice(n_brokers, size=rf, replace=False).tolist()
+        parts.append(PartitionAssignment("t", p, [int(b) for b in reps]))
+    topo = Topology(rack_of={b: f"r{b % n_racks}" for b in range(n_brokers)})
+    brokers = list(range(n_brokers - drop))
+    return Assignment(partitions=parts), brokers, topo
+
+
+def _solve(cluster, megachunk, pipeline=False, checkpoint=None, **kw):
+    # precompile=True + cert_min_savings_s=1e9: the deterministic knobs
+    # (see test_pipeline_parity.py) — fusion parity must not depend on
+    # constructor-race or certificate timing accidents. rounds=32 under
+    # a never-binding deadline forces the 4-piece chunk schedule.
+    current, brokers, topo = cluster
+    return optimize(
+        current, brokers, topo, solver="tpu", engine="sweep", seed=0,
+        batch=8, pipeline=pipeline, time_limit_s=NO_DEADLINE,
+        cert_min_savings_s=1e9, precompile=True, rounds=32,
+        checkpoint=checkpoint, megachunk=megachunk, **kw,
+    )
+
+
+def _assert_parity(r_mega, r_base):
+    s_m, s_b = r_mega.solve.stats, r_base.solve.stats
+    assert np.array_equal(r_mega.solve.a, r_base.solve.a)
+    assert r_mega.solve.objective == r_base.solve.objective
+    assert s_m["moves"] == s_b["moves"]
+    assert s_m["rounds_run"] == s_b["rounds_run"]
+    assert s_m["score_curve"] == s_b["score_curve"]
+    assert s_m["feasible"] is True
+
+
+def test_megachunk_bit_identical_to_chunked(rng):
+    """The tentpole acceptance: K∈{2,8}, sync and pipelined, all four
+    fused trajectories equal the unfused chunked solve exactly, with
+    fewer device dispatches; K=1 restores the per-chunk path with an
+    identical dispatch count."""
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    base = _solve(cluster, None)
+    s_b = base.solve.stats
+    n_chunks = s_b["dispatches"]  # chunked: one dispatch per chunk
+    assert n_chunks > 1
+    for pipeline in (False, True):
+        for k in (2, 8):
+            r = _solve(cluster, k, pipeline=pipeline)
+            mg = r.solve.stats["megachunk"]
+            _assert_parity(r, base)
+            # the resolved width is the request capped at the ladder
+            assert mg["k"] == min(k, n_chunks)
+            assert mg["mode"] == "static"
+            assert mg["chunks"] == n_chunks
+            assert mg["dispatches"] == -(-n_chunks // mg["k"])  # ceil
+            assert r.solve.stats["dispatches"] < n_chunks
+    r1 = _solve(cluster, 1, pipeline=True)
+    _assert_parity(r1, base)
+    assert r1.solve.stats["megachunk"]["k"] == 1
+    assert r1.solve.stats["dispatches"] == n_chunks
+
+
+def test_megachunk_mesh_parity_xla_and_interpret(rng):
+    """Mesh-level: one fused solve_megachunk dispatch over K=4 chunk
+    steps replays the 4-dispatch chunked loop bit-for-bit — final
+    state, champion, per-chunk curves — under BOTH the XLA scorer and
+    the Pallas kernel in interpret mode (the code path TPU compiles)."""
+    from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+    from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+    current, brokers, topo = random_cluster(rng, 10, 16, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    mesh = pm.make_mesh()
+    temps = arrays.geometric_temps(2.0, 0.05, 16)
+    segs = [temps[i * 4:(i + 1) * 4] for i in range(4)]
+    outs = {}
+    for scorer in ("xla", "pallas-interpret"):
+        # chunked reference: 4 sequential stateful dispatches
+        st = pm.init_sweep_state(m, seed, jax.random.PRNGKey(3), mesh, 2)
+        curves = []
+        for seg in segs:
+            st, ba, bk, cv = pm.solve_on_mesh(
+                m, seed, jax.random.PRNGKey(3), mesh, 2, rounds=4,
+                steps_per_round=2, engine="sweep", temps=seg,
+                scorer=scorer, state=st,
+            )
+            curves.append(np.asarray(cv))
+        chunked = (np.asarray(ba), np.asarray(bk),
+                   np.stack(curves, axis=1))
+        # fused: ONE dispatch, disarmed (all 4 steps execute)
+        st2 = pm.init_sweep_state(m, seed, jax.random.PRNGKey(3), mesh, 2)
+        (_st3, top_a, top_k, _ca, _ok, _mv, mcurves, execd
+         ) = pm.solve_megachunk(
+            m, mesh, 2, jnp.stack(segs), st2, steps_per_round=2,
+            scorer=scorer,
+        )
+        assert np.asarray(execd).all()  # disarmed: every step executed
+        np.testing.assert_array_equal(chunked[0], np.asarray(top_a))
+        np.testing.assert_array_equal(chunked[1], np.asarray(top_k))
+        np.testing.assert_array_equal(chunked[2], np.asarray(mcurves))
+        outs[scorer] = chunked
+    # and the two scorers agree with each other (Mosaic-path anchor)
+    for a, b in zip(outs["xla"], outs["pallas-interpret"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_megachunk_mesh_forced_certificate_exits_deterministically(rng):
+    """Forced on-device certificate: thresholds every chain satisfies
+    from the seed make the scan exit after step 0 — execd masks steps
+    1..3 as never-executed, the certificate snapshot is flagged, and a
+    replay is bit-identical (the early exit is pure device arithmetic,
+    no host wall-clock in the loop)."""
+    from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+    from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+
+    current, brokers, topo = random_cluster(rng, 10, 16, 2, 2, drop=1)
+    inst = build_instance(current, brokers, topo)
+    m = arrays.from_instance(inst)
+    seed = jnp.asarray(greedy_seed(inst), jnp.int32)
+    mesh = pm.make_mesh()
+    segs = jnp.stack(
+        [arrays.geometric_temps(2.0, 0.05, 16)[i * 4:(i + 1) * 4]
+         for i in range(4)]
+    )
+
+    def run():
+        st = pm.init_sweep_state(m, seed, jax.random.PRNGKey(3), mesh, 2)
+        out = pm.solve_megachunk(
+            m, mesh, 2, segs, st, steps_per_round=2, scorer="xla",
+            cert_k=-(2 ** 31) + 1, cert_mv=2 ** 31 - 1,
+        )
+        (_st, top_a, top_k, cert_a, cert_ok, cert_mv, _cv, execd) = out
+        return (np.asarray(top_a), np.asarray(top_k),
+                np.asarray(cert_a), np.asarray(cert_ok),
+                np.asarray(cert_mv), np.asarray(execd))
+
+    first, again = run(), run()
+    execd = first[5].reshape(-1, 4)
+    assert execd[:, 0].all() and not execd[:, 1:].any(), execd
+    assert first[3].all()  # every shard flagged the certificate
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_megachunk_early_exit_certifies_at_engine_level(monkeypatch):
+    """With bounds prewarmed, the constructor neutralized, certificate
+    economics disabled (negative threshold) and the weight bound forced
+    to a value every chain reaches, the fused ladder arms the
+    device-side exit, the scan retires after one chunk of four, and the
+    host certifies the snapshot — deterministically across a warm
+    replay. (The REAL decommission weight bound is only reached after
+    the host-side leader reseat, which the raw device threshold
+    deliberately excludes — so the forced bound is what makes the
+    device exit itself, not the boundary certificate, the thing under
+    test.)"""
+    from kafka_assignment_optimizer_tpu.solvers.tpu import engine as eng
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    monkeypatch.setattr(
+        eng, "_construct_worker", lambda *a, **k: (None, False, False)
+    )
+    sc = gen.SCENARIOS["decommission"](**gen.SMOKE_KWARGS["decommission"])
+    inst = build_instance(
+        sc.current, sc.broker_list, sc.topology, target_rf=sc.target_rf
+    )
+    lb = inst.move_lower_bound_exact()  # prewarm: the exact move bound
+    monkeypatch.setattr(inst, "weight_upper_bound", lambda *a, **k: 1)
+    kw = dict(seed=0, engine="sweep", batch=8, rounds=32,
+              time_limit_s=NO_DEADLINE, cert_min_savings_s=-1.0,
+              megachunk=4)
+    res = eng.solve_tpu(inst, **kw)
+    s = res.stats
+    assert s["feasible"]
+    assert s["moves"] == lb  # the move-count leg of the test is real
+    mg = s["megachunk"]
+    assert mg["k"] == 4
+    assert mg["early_exit"] is True
+    assert mg["chunks"] < 4  # the scan retired before the group's end
+    assert s["rounds_run"] < s["rounds"]
+    # warm replay: the early exit is device arithmetic, so the retired
+    # chunk count and the certified plan replay exactly
+    res2 = eng.solve_tpu(inst, **kw)
+    assert np.array_equal(res2.a, res.a)
+    assert res2.stats["megachunk"] == mg
+    assert res2.stats["rounds_run"] == s["rounds_run"]
+
+
+def test_megachunk_checkpoint_resume_across_boundary(rng, tmp_path):
+    """Fused and chunked solves write identical checkpoints, and a
+    resume from the fused solve's checkpoint — which was filed at a
+    MEGACHUNK boundary — replays to the chunked answer again."""
+    from kafka_assignment_optimizer_tpu.models.instance import (
+        build_instance as _bi,
+    )
+    from kafka_assignment_optimizer_tpu.utils import checkpoint as ckpt
+
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    ck_m = str(tmp_path / "mega" / "ck.npz")
+    ck_b = str(tmp_path / "base" / "ck.npz")
+    r_mega = _solve(cluster, 2, checkpoint=ck_m)
+    r_base = _solve(cluster, None, checkpoint=ck_b)
+    _assert_parity(r_mega, r_base)
+    inst = _bi(*cluster)
+    a_m, a_b = ckpt.load(ck_m, inst), ckpt.load(ck_b, inst)
+    assert a_m is not None and np.array_equal(a_m, a_b)
+    r_mega2 = _solve(cluster, 2, checkpoint=ck_m)
+    r_base2 = _solve(cluster, None, checkpoint=ck_b)
+    assert r_mega2.solve.stats["resumed_from_checkpoint"] is True
+    assert r_base2.solve.stats["resumed_from_checkpoint"] is True
+    _assert_parity(r_mega2, r_base2)
+    assert np.array_equal(r_mega2.solve.a, r_mega.solve.a)
+
+
+def test_megachunk_warm_resolve_compiles_nothing(rng, monkeypatch):
+    """One executable per (bucket, K): a warm re-solve at the same
+    fused width compiles NOTHING and — the donation round-trip — the
+    donated carry left no corrupted buffers behind, so the answer is
+    identical. Compiles counted via the lowering hook
+    (tests/test_bucketing.py idiom)."""
+    from kafka_assignment_optimizer_tpu.parallel import mesh
+
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    compiles: list = []
+    real = mesh._lower_and_compile
+
+    def counting(fn, args):
+        compiles.append(mesh._arg_signature(args))
+        return real(fn, args)
+
+    monkeypatch.setattr(mesh, "_lower_and_compile", counting)
+    r1 = _solve(cluster, 8)
+    after_first = len(compiles)
+    r2 = _solve(cluster, 8)
+    assert len(compiles) == after_first, (
+        f"warm same-(bucket,K) re-solve recompiled: "
+        f"{compiles[after_first:]}"
+    )
+    assert np.array_equal(r1.solve.a, r2.solve.a)
+    assert r1.solve.stats["score_curve"] == r2.solve.stats["score_curve"]
+    assert r2.solve.stats["megachunk"]["k"] > 1
+
+
+def test_megachunk_warm_estimate_is_width_keyed(rng):
+    """Satellite pin: fused measurements file under their own width key
+    — a K=2 solve must not move the K=1 warm estimate the per-chunk
+    deadline gates read (a fused group amortizes per-dispatch host
+    overhead the unfused chunk pays, so cross-feeding would deflate the
+    chunked estimate and inflate the fused one)."""
+    from kafka_assignment_optimizer_tpu.solvers.tpu.engine import (
+        _WARM_CHUNKS,
+    )
+
+    cluster = random_cluster(rng, 12, 48, 3, 3, drop=1)
+    _WARM_CHUNKS.clear()
+    _solve(cluster, None)
+    before = dict(_WARM_CHUNKS._d)
+    assert before, "chunked solve filed no warm estimate"
+    # the registry key is (*warm_key, chunk_len, width, scorer)
+    assert all(k[-2] == 1 for k in before)
+    _solve(cluster, 2)
+    after = dict(_WARM_CHUNKS._d)
+    for k, v in before.items():
+        assert after[k] == v, f"fused solve moved the width-1 entry {k}"
+    mega_keys = [k for k in after if k[-2] == 2]
+    assert mega_keys, "fused solve filed no width-keyed estimate"

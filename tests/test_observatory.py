@@ -621,7 +621,7 @@ def test_engine_solve_lands_one_flight_record(tmp_path):
     assert rec["quality"]["feasible"] is True
     assert rec["quality"]["moves"] == res.stats["moves"]
     assert set(rec["split"]) == {"compile_s", "device_s", "dispatch_s",
-                                "host_s"}
+                                "host_s", "dispatches", "duty_cycle"}
     assert "bounds" in rec["phases"] and "ladder" in rec["phases"]
     assert rec["bucket"][0] == 19  # demo brokers
     # the record also hit the durable JSONL

@@ -339,6 +339,35 @@ def test_point_pallas_fault_all_three_views_agree(inst):
     assert 'kao_degradations_total{rung="pallas_to_xla"} 1' in text
 
 
+def test_point_megachunk_fault_three_views_and_chunked_parity(inst):
+    """A fault inside a fused megachunk dispatch steps down the
+    ``megachunk_to_chunked`` rung and the per-chunk ladder finishes the
+    solve. Three views agree (stats, trace mark, metric counter), and —
+    because the drain re-enters at the first unfinished chunk with the
+    carried state intact — the answer is bit-identical to a never-fused
+    chunked solve."""
+    kw = dict(seed=0, engine="sweep", batch=8, rounds=32,
+              time_limit_s=3600.0, cert_min_savings_s=1e9)
+    before = ladder.snapshot()["megachunk_to_chunked"]
+    chaos.arm("megachunk_fault")
+    res = solve_tpu(inst, trace=True, megachunk=2, **kw)
+    _assert_valid(inst, res)
+    assert chaos.snapshot()["fired"].get("megachunk_fault") == 1
+    stats_rungs = [r for r in res.stats["degradations"]
+                   if r == "megachunk_to_chunked"]
+    trace_rungs = [r for r in _degrade_rungs(res.stats["solve_report"])
+                   if r == "megachunk_to_chunked"]
+    metric_delta = ladder.snapshot()["megachunk_to_chunked"] - before
+    assert len(stats_rungs) == len(trace_rungs) == metric_delta == 1
+    text = srv.render_metrics()
+    assert 'kao_degradations_total{rung="megachunk_to_chunked"} 1' in text
+    # drained-solve parity with the unfused chunked path
+    chaos.disarm()
+    base = solve_tpu(inst, **kw)
+    assert np.array_equal(res.a, base.a)
+    assert res.stats["score_curve"] == base.stats["score_curve"]
+
+
 def test_point_nan_chunk_host_fallback_flagged_degraded(inst):
     chaos.arm("nan_chunk")
     res = solve_tpu(inst, **KNOBS)
