@@ -496,7 +496,49 @@ def run_scenario(name: str, smoke: bool, seed: int, warm: bool) -> dict:
             if default_wall is not None else {}
         ),
         **_sampler_block(sampler),
+        **_profile_block(),
     }
+
+
+def _profile_block() -> dict:
+    """The headline row's roofline/attribution columns (obs.prof,
+    docs/OBSERVABILITY.md "Reading a roofline"): the dominant
+    executable's cost model + achieved occupancy, and the last solve's
+    ledger shares — the measured columns regress.py's efficiency gate
+    compares between artifacts (occupancy RATIO drops and attribution
+    share shifts trip exit 3 like any latency regression)."""
+    from kafka_assignment_optimizer_tpu.obs import flight as _flight
+    from kafka_assignment_optimizer_tpu.obs import prof as _prof
+
+    prof: dict = {}
+    try:
+        rows = _prof.snapshot()["executables"]
+        if rows:
+            top = rows[0]  # most device seconds = the dominant exec
+            for f in ("flops", "bytes_accessed", "peak_hbm_bytes",
+                      "occupancy_flops", "occupancy_hbm",
+                      "occupancy_hbm_p50", "occupancy_hbm_p99",
+                      "dispatches", "device_s"):
+                if top.get(f) is not None:
+                    prof[f] = top[f]
+        led = None
+        for rec in reversed(_flight.recent(8)):
+            if isinstance(rec.get("ledger"), dict):
+                led = rec["ledger"]
+                break
+        if led:
+            wall = float(led.get("wall_s") or 0.0)
+            if wall > 0:
+                prof["device_share"] = round(
+                    float(led.get("device_s") or 0.0) / wall, 4)
+                prof["ledger_shares"] = {
+                    f: round(float(led.get(f) or 0.0) / wall, 4)
+                    for f in _prof.LEDGER_FIELDS
+                }
+            prof["ledger_ok"] = bool(led.get("ok"))
+    except Exception:
+        pass
+    return {"profile": prof} if prof else {}
 
 
 def _duty_cycle(stats: dict) -> float | None:
@@ -1877,6 +1919,12 @@ def emit(head: dict | None, platform: str, tpu_error: str | None,
         # per-device memory, and the sampler's measured overhead
         # (docs/OBSERVABILITY.md "Fleet plane")
         line["device_sampler"] = head["device_sampler"]
+    if "profile" in head:
+        # roofline/attribution columns (obs.prof): the dominant
+        # executable's cost model + achieved occupancy and the last
+        # solve's ledger shares — never shed, obs/regress.py's
+        # efficiency gate compares these between artifacts
+        line["profile"] = head["profile"]
     if "kernel" in head:
         line["kernel"] = _compact_kernel(head["kernel"])
     _print_final(line)

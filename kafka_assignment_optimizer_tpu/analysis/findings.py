@@ -41,6 +41,8 @@ RULES: dict[str, str] = {
     "KAO112": "per-partition Python for loop in a decompose hot module",
     "KAO113": "host sync inside a scan body (serializes a fused "
               "megachunk)",
+    "KAO114": "wall-clock delta outside the accounting funnel in a "
+              "dispatch hot module",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -82,6 +82,16 @@ caught only dynamically, alignment- or platform-dependently):
   or — worse — silently forces a mid-scan host round-trip and the
   fused dispatch degenerates to per-chunk latency. Detected on any
   function passed as the body of a ``lax.scan`` call.
+- **KAO114** ad-hoc timer deltas outside the accounting funnel in the
+  dispatch hot modules (``parallel/mesh.py``, ``solvers/tpu/
+  engine.py``): the attribution-ledger contract (ISSUE 18,
+  docs/OBSERVABILITY.md "Attribution ledgers") is that every
+  ``time.perf_counter()`` delta measured in a function that reaches a
+  dispatch/compile site lands in a recording sink — ``obs.flight``'s
+  ``note_*``/``attribute`` windows, a retire/record/span-attr call, a
+  result field — never in a local-only computation. A delta that only
+  feeds a log line or a branch is wall the ledger cannot see, and the
+  sums-to-wall invariant quietly degrades into a growing ``other_s``.
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -194,6 +204,7 @@ def lint_source(
     out += _rule_lane_config_capture(tree, path)
     out += _rule_uninjected_http(tree, path, rel)
     out += _rule_scan_host_sync(tree, path)
+    out += _rule_time_delta(tree, path, rel)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -1014,4 +1025,220 @@ def _rule_metrics_help_type(tree, path) -> list[Finding]:
                 "contract, tests/test_metrics_format.py)")
         for fam, line in sorted(emitted.items(), key=lambda kv: kv[1])
         if documented.get(fam, set()) != {"HELP", "TYPE"}
+    ]
+
+
+# ---------------------------------------------------------------- KAO114
+
+# the dispatch hot modules: every wall-clock delta measured here sits
+# on a solve's critical path, and the attribution-ledger contract
+# (ISSUE 18) is ONE accounting funnel — obs.flight windows, retire/
+# record sinks, span attrs, result fields — so the ledger's
+# sums-to-wall invariant stays meaningful
+_ACCOUNTING_HOT_FILES = ("parallel/mesh.py", "solvers/tpu/engine.py")
+_TIMER_FNS = {"perf_counter", "monotonic", "time"}
+# a function "reaches a dispatch/compile site" when it calls one of
+# these shapes — pure host helpers that merely time themselves are
+# out of scope
+_DISPATCH_SITE_RE = re.compile(
+    r"dispatch|compile|solve_|block_until_ready|fetch_global|lower"
+)
+# call names that COUNT as the accounting funnel: flight/prof note_*
+# hooks, record/observe/retire sinks, span-attr setters, ledger/window
+# helpers, and result constructors whose consumers do the recording
+_FUNNEL_RE = re.compile(
+    r"note_|record|observe|retire|attrs|\.set$|\.update$|SolveResult"
+    r"|_select_lanes|ledger|window|attribute|chunk_attrs"
+)
+
+
+def _is_timer_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return len(d) == 2 and d[0] == "time" and d[1] in _TIMER_FNS
+
+
+def _is_timer_delta(node: ast.AST) -> bool:
+    """A literal wall-clock measurement: ``time.perf_counter() - t0``.
+    Timer on the LEFT only — elapsed wall is always now-minus-mark,
+    while ``deadline - time.perf_counter()`` (timer on the right) is a
+    remaining-headroom check, control flow rather than measurement."""
+    return (
+        isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+        and _is_timer_call(node.left)
+    )
+
+
+def _call_name(call: ast.Call) -> str:
+    d = _dotted(call.func)
+    if d:
+        return ".".join(d)
+    if isinstance(call.func, ast.Attribute):
+        # method on a computed receiver (``span(...).set``): the attr
+        # alone still identifies the funnel vocabulary
+        return "." + call.func.attr
+    return ""
+
+
+def _names_in(node: ast.AST, names) -> set[str]:
+    return {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        and n.id in names
+    }
+
+
+def _rule_time_delta(tree, path, rel) -> list[Finding]:
+    """Flag ``time.perf_counter()``-style deltas in the dispatch hot
+    modules that never reach the accounting funnel. A delta (or a name
+    bound from one, through simple assignment chains) is CLEAN when it
+    escapes into a funnel call (``note_*``/record/observe/retire/
+    span-``.set``/``chunk_attrs``/``SolveResult``/...), a ``return``
+    value, an attribute or subscript store, or an augmented assignment
+    to a ``nonlocal``/``global`` accumulator — all shapes whose
+    consumers land the seconds in a flight record. Anything else
+    (a delta feeding only a log line, a print, or a branch) is wall
+    the ledger cannot attribute. Suppressible with justification
+    (``# kao: disable=KAO114 -- reason``) for genuinely
+    non-accountable timing (e.g. test-only instrumentation)."""
+    if not rel.endswith(_ACCOUNTING_HOT_FILES):
+        return []
+    out: list[Finding] = []
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out += _time_delta_findings(fn, path)
+    return out
+
+
+def _time_delta_findings(fn, path) -> list[Finding]:
+    own = list(_walk_own_scope(fn))
+    deltas = [n for n in own if _is_timer_delta(n)]
+    if not deltas:
+        return []
+    # scope gate: only functions that reach a dispatch/compile site
+    if not any(
+        isinstance(n, ast.Call)
+        and _DISPATCH_SITE_RE.search(_call_name(n))
+        for n in own
+    ):
+        return []
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    shared = {
+        nm for n in own
+        if isinstance(n, (ast.Nonlocal, ast.Global)) for nm in n.names
+    }
+
+    # origins: tainted name -> delta lines it carries; pending/escaped
+    # track delta lines still unaccounted vs proven funneled
+    origins: dict[str, set[int]] = {}
+    pending: set[int] = set()
+    escaped: set[int] = set()
+    immediate: list[int] = []
+
+    def _stmt_and_funnel(node):
+        """Walk up to the enclosing statement; True when any ancestor
+        call on the way matches the funnel vocabulary."""
+        funneled = False
+        cur = node
+        while cur in parents and not isinstance(cur, ast.stmt):
+            cur = parents[cur]
+            if isinstance(cur, ast.Call) \
+                    and _FUNNEL_RE.search(_call_name(cur)):
+                funneled = True
+        return cur, funneled
+
+    for d in deltas:
+        stmt, funneled = _stmt_and_funnel(d)
+        if funneled or isinstance(stmt, ast.Return):
+            continue
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        if targets:
+            flat = [
+                e for t in targets
+                for e in (getattr(t, "elts", None) or [t])
+            ]
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in flat):
+                continue  # stored on an object/container: escapes
+            if isinstance(stmt, ast.AugAssign) and any(
+                isinstance(t, ast.Name) and t.id in shared for t in flat
+            ):
+                continue  # accumulated into a shared tally
+            names = [t.id for t in flat if isinstance(t, ast.Name)]
+            if names:
+                for nm in names:
+                    origins.setdefault(nm, set()).add(d.lineno)
+                pending.add(d.lineno)
+                continue
+        immediate.append(d.lineno)
+
+    # propagate taint through assignment chains and find escapes, to a
+    # fixpoint (chains are short; this converges in a few passes)
+    changed = True
+    while changed and pending - escaped:
+        changed = False
+        for n in own:
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                    and n.value is not None:
+                hit = set().union(*(
+                    origins[nm] for nm in _names_in(n.value, origins)
+                )) if _names_in(n.value, origins) else set()
+                if not hit:
+                    continue
+                targets = (
+                    n.targets if isinstance(n, ast.Assign) else [n.target]
+                )
+                flat = [
+                    e for t in targets
+                    for e in (getattr(t, "elts", None) or [t])
+                ]
+                for t in flat:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        if not hit <= escaped:
+                            escaped |= hit
+                            changed = True
+                    elif isinstance(t, ast.Name):
+                        if isinstance(n, ast.AugAssign) \
+                                and t.id in shared:
+                            if not hit <= escaped:
+                                escaped |= hit
+                                changed = True
+                        elif not hit <= origins.setdefault(t.id, set()):
+                            origins[t.id] |= hit
+                            changed = True
+            elif isinstance(n, ast.Call) \
+                    and _FUNNEL_RE.search(_call_name(n)):
+                hit = set().union(*(
+                    origins[nm] for nm in _names_in(n, origins)
+                )) if _names_in(n, origins) else set()
+                if hit and not hit <= escaped:
+                    escaped |= hit
+                    changed = True
+            elif isinstance(n, ast.Return) and n.value is not None:
+                hit = set().union(*(
+                    origins[nm] for nm in _names_in(n.value, origins)
+                )) if _names_in(n.value, origins) else set()
+                if hit and not hit <= escaped:
+                    escaped |= hit
+                    changed = True
+
+    msg = (
+        "wall-clock delta outside the accounting funnel in a "
+        "dispatch hot module: this timing never reaches obs.flight "
+        "(note_window/note_device/attribute) or a recording sink, so "
+        "the attribution ledger's sums-to-wall invariant cannot see "
+        "it (docs/OBSERVABILITY.md 'Attribution ledgers'); route it "
+        "through the funnel or suppress with justification"
+    )
+    return [
+        Finding("KAO114", path, ln, msg)
+        for ln in sorted(set(immediate) | (pending - escaped))
     ]

@@ -120,9 +120,25 @@ def worker_key(rec: dict) -> str:
 # --------------------------------------------------------------------------
 
 
+# attribution-ledger categories (docs/OBSERVABILITY.md "Reading a
+# roofline"). LEAF windows are measured directly at their source —
+# mesh's compile/dispatch-enqueue/transfer sites, the engine's device
+# waits — and are disjoint by construction (all on the solve thread,
+# none nested in another leaf). NESTED windows (boundary, constructor)
+# wrap blocks that may CONTAIN leaf windows; :func:`attribute` nets the
+# leaf seconds accrued inside back out, so a transfer inside a chunk
+# boundary is counted once as transfer, never twice.
+LEDGER_LEAVES = ("compile", "dispatch", "device", "transfer")
+LEDGER_NESTED = ("boundary", "constructor")
+# sums-to-wall epsilon: 8 components rounded at 4 decimals plus
+# cross-thread clock skew; relative term covers long solves
+LEDGER_EPS_S = 0.005
+LEDGER_EPS_FRAC = 0.01
+
+
 class _SolveAcc:
     __slots__ = ("compile_s", "compiles", "cache_hits", "cache_misses",
-                 "cache_fallbacks")
+                 "cache_fallbacks", "seconds", "leaf_s")
 
     def __init__(self):
         self.compile_s = 0.0
@@ -130,6 +146,11 @@ class _SolveAcc:
         self.cache_hits = 0
         self.cache_misses = 0
         self.cache_fallbacks = 0
+        # per-category measured seconds for the attribution ledger;
+        # leaf_s tracks the LEAF total so nested windows can net out
+        # the leaf time accrued inside them
+        self.seconds = dict.fromkeys(LEDGER_LEAVES + LEDGER_NESTED, 0.0)
+        self.leaf_s = 0.0
 
 
 _ACC: contextvars.ContextVar = contextvars.ContextVar(
@@ -145,6 +166,14 @@ _CTX: contextvars.ContextVar = contextvars.ContextVar(
 def start_accounting():
     """Begin a per-solve compile/cache accumulator on this context;
     returns the token for :func:`end_accounting`."""
+    try:
+        from . import prof as _oprof
+
+        # a stale speculative dispatch from a previous solve on this
+        # context must not mispair with this solve's device waits
+        _oprof.reset_pending()
+    except Exception:
+        pass
     return _ACC.set(_SolveAcc())
 
 
@@ -167,11 +196,17 @@ def end_accounting(token) -> _SolveAcc | None:
 
 def note_compile(seconds: float) -> None:
     """One XLA compile attributed to the current solve (mesh calls
-    this next to its process-global counters)."""
+    this next to its process-global counters). Also the ledger's
+    compile LEAF window — on first contact the enqueue of the freshly
+    compiled executable is inside this measurement (docs/PIPELINE.md's
+    compile-inclusive-dispatch convention, inverted), so the miss path
+    records NO separate dispatch window."""
     acc = _ACC.get()
     if acc is not None:
         acc.compile_s += float(seconds)
         acc.compiles += 1
+        acc.seconds["compile"] += float(seconds)
+        acc.leaf_s += float(seconds)
 
 
 def note_dispatch(cache: str) -> None:
@@ -185,6 +220,129 @@ def note_dispatch(cache: str) -> None:
         acc.cache_misses += 1
     else:
         acc.cache_fallbacks += 1
+
+
+def note_window(category: str, seconds: float) -> None:
+    """One LEAF attribution window measured at its source: ``dispatch``
+    (mesh's enqueue time around ``ex(*args)``, compile-exclusive),
+    ``device`` (the engine's retire-side ``block_until_ready`` wait),
+    ``transfer`` (``fetch_global``). Leaves are disjoint on the solve
+    thread by construction; :func:`attribute` blocks net them out."""
+    acc = _ACC.get()
+    if acc is not None:
+        acc.seconds[category] += float(seconds)
+        acc.leaf_s += float(seconds)
+
+
+def note_device(seconds: float) -> None:
+    """One retire-side device wait: the ledger's device leaf AND the
+    profiler's occupancy pairing (enqueue→retire window against the
+    executable's cached cost model) in one call — the engine's walkers
+    feed both planes through this single funnel."""
+    note_window("device", seconds)
+    try:
+        from . import prof as _oprof
+
+        _oprof.note_device(seconds)
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def attribute(category: str):
+    """Measure a NESTED attribution window (``boundary``,
+    ``constructor``): the block's wall minus whatever leaf windows
+    accrued inside it — a ``fetch_global`` inside a chunk boundary
+    lands once under transfer, and the boundary figure is the host
+    work that remains. Never double-counts by construction."""
+    acc = _ACC.get()
+    if acc is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    leaf0 = acc.leaf_s
+    try:
+        yield
+    finally:
+        net = (time.perf_counter() - t0) - (acc.leaf_s - leaf0)
+        if net > 0:
+            acc.seconds[category] += net
+
+
+def ledger_marks() -> dict:
+    """Cumulative funnel totals of the CURRENT solve accumulator —
+    the engine differences these around a ladder so the megachunk
+    evidence table is fed from the same measured windows the ledger
+    lands (one accounting funnel; the two can never disagree)."""
+    acc = _ACC.get()
+    if acc is None:
+        return {"dispatches": 0, "dispatch_s": 0.0, "device_s": 0.0}
+    return {
+        "dispatches": (acc.cache_hits + acc.cache_misses
+                       + acc.cache_fallbacks),
+        "dispatch_s": acc.seconds["dispatch"],
+        "device_s": acc.seconds["device"],
+    }
+
+
+# queue-wait tagging (serve's worker hop): the seconds a request sat in
+# the solve queue before a worker picked it up. A dedicated contextvar
+# (not `context()`): the watch manager's delta tagging REPLACES the
+# ambient context, and the queue share must survive that
+_QWAIT: contextvars.ContextVar = contextvars.ContextVar(
+    "kao_flight_qwait", default=0.0
+)
+
+
+def set_queue_wait(seconds: float):
+    """Tag solves on this context with measured queue-wait seconds
+    (serve's ``_SolveQueue._execute`` hop); returns the reset token."""
+    return _QWAIT.set(max(float(seconds), 0.0))
+
+
+def reset_queue_wait(token) -> None:
+    try:
+        _QWAIT.reset(token)
+    except ValueError:
+        pass
+
+
+def _ledger(acc: _SolveAcc | None, wall_s: float,
+            trace_id=None) -> dict:
+    """The wall-clock attribution ledger: every measured category plus
+    the unattributed remainder, summing to ``wall_s`` + queue wait
+    within epsilon. ``ok=False`` (plus a profiler counter) marks a
+    ledger whose measured components exceeded the wall beyond epsilon
+    — surfaced, never silently clamped."""
+    secs = acc.seconds if acc is not None else {}
+    queue_wait = _QWAIT.get()
+    comp = {
+        "constructor_s": secs.get("constructor", 0.0),
+        "compile_s": secs.get("compile", 0.0),
+        "dispatch_gap_s": secs.get("dispatch", 0.0),
+        "device_s": secs.get("device", 0.0),
+        "transfer_s": secs.get("transfer", 0.0),
+        "boundary_s": secs.get("boundary", 0.0),
+    }
+    measured = sum(comp.values())
+    other = wall_s - measured
+    eps = max(LEDGER_EPS_S, LEDGER_EPS_FRAC * wall_s)
+    ok = other >= -eps
+    if not ok:
+        try:
+            from . import prof as _oprof
+
+            _oprof.note_ledger_overrun()
+        except Exception:
+            pass
+    led = {
+        "wall_s": round(queue_wait + wall_s, 4),
+        "queue_wait_s": round(queue_wait, 4),
+        **{k: round(v, 4) for k, v in comp.items()},
+        "other_s": round(max(other, 0.0), 4),
+        "ok": ok,
+    }
+    return led
 
 
 @contextlib.contextmanager
@@ -601,6 +759,11 @@ def record_solve(result, inst=None, acc: _SolveAcc | None = None,
             "bucket": bucket,
             "wall_s": round(wall, 4),
             "phases": phases,
+            # wall-clock attribution (docs/OBSERVABILITY.md "Reading a
+            # roofline"): queue-wait / constructor / compile /
+            # dispatch-gap / device / transfer / boundary / other,
+            # summing to wall + queue within epsilon
+            "ledger": _ledger(acc, wall),
             "split": _split(st, acc, wall),
             "cache": {
                 "hits": acc.cache_hits if acc else 0,
@@ -651,6 +814,13 @@ def record_solve(result, inst=None, acc: _SolveAcc | None = None,
         for key, v in {**ctx, **(extra or {})}.items():
             if key != "kind" and key not in rec:
                 rec[key] = v
+        if rep:
+            # dispatch-gap series from the solve report's span
+            # timestamps (obs.prof): p99-gap exemplars carry this
+            # trace_id into the ISSUE 15 trace chain
+            from . import prof as _oprof
+
+            _oprof.observe_gaps(rep, rec.get("trace_id"))
         record(rec)
         return rec
     except Exception as e:
@@ -679,6 +849,10 @@ def record_failure(inst, acc: _SolveAcc | None, wall_s: float,
             ),
             "wall_s": round(float(wall_s), 4),
             "phases": {},
+            # failures still carry their measured windows — whatever
+            # the solve paid before raising is attributed, the rest
+            # lands in other
+            "ledger": _ledger(acc, float(wall_s)),
             "split": _split({}, acc, float(wall_s)),
             "cache": {
                 "hits": acc.cache_hits if acc else 0,
@@ -733,6 +907,10 @@ def record_optimize(result) -> dict | None:
                        None, None],
             "wall_s": round(float(result.wall_clock_s), 4),
             "phases": {},
+            # exact-oracle solves pay no device windows: the ledger is
+            # degenerate (queue + other = wall) but PRESENT, so every
+            # record kind answers the same attribution query
+            "ledger": _ledger(None, float(result.wall_clock_s)),
             "split": {"compile_s": 0.0, "device_s": 0.0,
                       "dispatch_s": 0.0,
                       "host_s": round(float(result.wall_clock_s), 4)},

@@ -1,0 +1,657 @@
+"""Continuous roofline observatory (docs/OBSERVABILITY.md).
+
+The ROADMAP's standing perf justification is a hand-measured roofline
+("~15% HBM / ~4% compute, device mostly idle") that nothing in the tree
+re-measures. This module makes it a live, per-executable invariant:
+
+- **Cost models**: at every AOT compile site (``parallel.mesh._dispatch``
+  — single, lanes, mega, mega-lanes), :func:`note_cost_model` captures
+  the executable's XLA ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (peak HBM) ONCE and caches it under the same
+  ``(solver_key, arg_signature)`` key as the exec-cache entry. Capture
+  is compile-time work: a warm re-solve reuses the cached analysis with
+  zero recomputation (tests pin this by counting captures while
+  monkeypatching ``_lower_and_compile``).
+- **Occupancy**: every dispatch stamps an enqueue-end timestamp
+  (:func:`note_dispatch`); the engine's retire-side device wait pairs
+  with it (:func:`note_device`), and the enqueue→retire window plus the
+  cost model yield achieved FLOP/s and GB/s versus backend peaks — a
+  rolling per-executable roofline with occupancy percentiles. The
+  pairing queue is a per-solve contextvar, so pipelined ladders (two
+  dispatches in flight) pair honestly and concurrent serve workers
+  never cross streams.
+- **Dispatch gaps**: :func:`observe_gaps` derives the gap series (end
+  of one ladder dispatch to the start of the next) from the existing
+  solve-report span timestamps and lands it in an
+  :class:`~obs.trace.ExemplarHistogram`, so the p99 gap carries a
+  trace_id that resolves through ``GET /debug/solves/<id>`` into the
+  ISSUE 15 trace chain.
+- **Attribution**: :func:`attribution_summary` / :func:`worst_solves`
+  aggregate the flight ledgers (``obs.flight`` builds them; this module
+  reads them) for ``GET /debug/profile`` and the offline ``kao-prof``
+  CLI, which runs the same aggregation over flight JSONL dirs —
+  fleet-wide via the ``obs.fleet`` merge.
+
+Every hook self-accounts its own wall cost (``overhead()``); tier-1
+asserts the profiler stays under 2% of solve wall. Peaks default per
+platform and are env-overridable (``KAO_PROF_PEAK_FLOPS`` /
+``KAO_PROF_PEAK_BYTES_S``) — absolute occupancy is only as good as the
+peak it is normalized by, so the regression gate (``obs.regress``)
+compares occupancy RATIOS between artifacts of the same environment,
+never absolutes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextvars
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+
+from .trace import ExemplarHistogram
+
+__all__ = [
+    "note_cost_model", "note_dispatch", "note_device", "reset_pending",
+    "observe_gaps", "forget_key", "clear", "peaks", "snapshot",
+    "roofline", "attribution_summary", "worst_solves", "overhead",
+    "gap_snapshot", "gap_exemplars", "main",
+]
+
+# dispatch-gap histogram bounds: warm ladder gaps sit in the 0.1-5 ms
+# band on CPU (sub-ms on TPU); the tail buckets catch a host stall or
+# GC pause between chunks
+GAP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.5, 2.0)
+
+# rolling occupancy window per executable: enough dispatches to make
+# p99 meaningful on a long ladder, bounded so a service never grows
+_OCC_SAMPLES = 256
+# cost-model cache bound: follows the exec cache (_EXECUTABLES_MAX=32)
+# with headroom — entries are a few floats, eviction mirrors the exec
+# cache via forget_key, this cap is only the orphan backstop
+_COST_MAX = 128
+
+# per-platform peak defaults for the occupancy denominator. The TPU
+# numbers are v5e-ish (bf16 MXU peak, HBM bandwidth); CPU/GPU defaults
+# are order-of-magnitude placeholders — override with
+# KAO_PROF_PEAK_FLOPS / KAO_PROF_PEAK_BYTES_S for real hardware.
+# Absolute occupancy is advisory; the regression gate compares ratios.
+_PEAK_DEFAULTS = {
+    "tpu": (197e12, 819e9),
+    "gpu": (60e12, 1000e9),
+    "cpu": (100e9, 50e9),
+}
+
+_LOCK = threading.Lock()
+# exec key -> cost model row (captured once per compile)
+_COST: OrderedDict = OrderedDict()
+# exec key -> runtime totals + rolling occupancy samples
+_RUNTIME: dict = {}
+_COUNTERS = {
+    "captures_total": 0,       # cost models captured (one per compile)
+    "capture_errors_total": 0,  # cost_analysis unavailable/raised
+    "reuses_total": 0,         # dispatches served by a cached model
+    "unpaired_device_total": 0,  # device waits with no pending dispatch
+    "ledger_overruns_total": 0,  # ledgers whose parts exceeded wall+eps
+}
+# profiler self-accounting: wall seconds spent inside the note_* hooks
+# (the <2% overhead assertion reads this; sampler.py idiom)
+_OVERHEAD = {"seconds_total": 0.0, "ops_total": 0}
+
+# per-solve pairing queue: (exec_key, enqueue_end_ts) in dispatch
+# order. Contextvar — each serve worker thread pairs its own stream.
+_PENDING: contextvars.ContextVar = contextvars.ContextVar(
+    "kao_prof_pending", default=None
+)
+
+GAP_HIST = ExemplarHistogram(GAP_BUCKETS)
+
+
+def _account(t0: float) -> None:
+    dt = time.perf_counter() - t0
+    with _LOCK:
+        _OVERHEAD["seconds_total"] += dt
+        _OVERHEAD["ops_total"] += 1
+
+
+def peaks() -> dict:
+    """The occupancy denominators for this process's backend:
+    ``{"platform", "flops", "bytes_s"}`` (env-overridable)."""
+    platform = "cpu"
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    flops, bw = _PEAK_DEFAULTS.get(platform, _PEAK_DEFAULTS["cpu"])
+    try:
+        flops = float(os.environ.get("KAO_PROF_PEAK_FLOPS") or flops)
+    except ValueError:
+        pass
+    try:
+        bw = float(os.environ.get("KAO_PROF_PEAK_BYTES_S") or bw)
+    except ValueError:
+        pass
+    return {"platform": platform, "flops": flops, "bytes_s": bw}
+
+
+# --------------------------------------------------------------------------
+# cost-model capture (mesh's compile site calls this once per compile)
+# --------------------------------------------------------------------------
+
+
+def _first_analysis(obj):
+    """``cost_analysis()`` returns a dict on current jax, a list of
+    per-computation dicts on older versions; normalize to one dict."""
+    if isinstance(obj, dict):
+        return obj
+    if isinstance(obj, (list, tuple)) and obj and isinstance(obj[0], dict):
+        return obj[0]
+    return None
+
+
+def _extract_cost(ex) -> dict:
+    """Flops / bytes / peak HBM from a compiled executable's XLA
+    analyses. Defensive by contract: any backend may decline any field
+    (None then rides the row; consumers skip None denominators)."""
+    flops = bytes_accessed = None
+    try:
+        ca = _first_analysis(ex.cost_analysis())
+        if ca:
+            v = ca.get("flops")
+            flops = float(v) if v is not None else None
+            v = ca.get("bytes accessed", ca.get("bytes_accessed"))
+            bytes_accessed = float(v) if v is not None else None
+    except Exception:
+        pass
+    peak_hbm = None
+    try:
+        ma = ex.memory_analysis()
+        # field names vary across jax versions/backends; peak device
+        # memory = arguments + outputs + temps (generated code is
+        # negligible and not HBM-resident on TPU)
+        parts = [
+            getattr(ma, f, None)
+            for f in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes")
+        ]
+        vals = [float(p) for p in parts if p is not None]
+        if vals:
+            peak_hbm = sum(vals)
+            alias = getattr(ma, "alias_size_in_bytes", None)
+            if alias is not None:
+                # donated/aliased buffers are counted in both argument
+                # and output totals but occupy HBM once
+                peak_hbm -= float(alias)
+    except Exception:
+        pass
+    return {"flops": flops, "bytes_accessed": bytes_accessed,
+            "peak_hbm_bytes": peak_hbm}
+
+
+def note_cost_model(key: tuple, ex, compile_s: float) -> None:
+    """Capture + cache the XLA cost analysis for a freshly compiled
+    executable (called from ``mesh._dispatch``'s miss path, right after
+    ``_lower_and_compile``). Never raises."""
+    t0 = time.perf_counter()
+    try:
+        row = _extract_cost(ex)
+        row["compile_s"] = round(float(compile_s), 4)
+        ok = row["flops"] is not None or row["bytes_accessed"] is not None
+        with _LOCK:
+            _COST[key] = row
+            _COST.move_to_end(key)
+            while len(_COST) > _COST_MAX:
+                old = _COST.popitem(last=False)[0]
+                _RUNTIME.pop(old, None)
+            _COUNTERS["captures_total"] += 1
+            if not ok:
+                _COUNTERS["capture_errors_total"] += 1
+    except Exception:
+        with _LOCK:
+            _COUNTERS["capture_errors_total"] += 1
+    finally:
+        _account(t0)
+
+
+def has_cost_model(key: tuple) -> bool:
+    with _LOCK:
+        return key in _COST
+
+
+def forget_key(key: tuple) -> None:
+    """Drop one executable's cost model + runtime totals (mesh calls
+    this wherever the exec cache evicts the key, so the two lifecycles
+    stay aligned — docs/DESIGN.md)."""
+    with _LOCK:
+        _COST.pop(key, None)
+        _RUNTIME.pop(key, None)
+
+
+def clear() -> None:
+    """Full reset (exec-cache clear + tests)."""
+    with _LOCK:
+        _COST.clear()
+        _RUNTIME.clear()
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+        _OVERHEAD["seconds_total"] = 0.0
+        _OVERHEAD["ops_total"] = 0
+    GAP_HIST.reset()
+
+
+# --------------------------------------------------------------------------
+# dispatch/device pairing -> occupancy samples
+# --------------------------------------------------------------------------
+
+
+def reset_pending() -> None:
+    """Fresh pairing queue for a new solve (flight.start_accounting
+    calls this): an abandoned speculative dispatch from a previous
+    solve must not mispair with this solve's first device wait."""
+    d = _PENDING.get()
+    if d is not None:
+        d.clear()
+
+
+def note_dispatch(key: tuple) -> None:
+    """One executable dispatch ENQUEUED (the ``ex(*args)`` call
+    returned): stamp the pairing queue and count the dispatch against
+    the key's cost model. Called from ``mesh._dispatch`` hit+miss
+    paths; fallback (plain jit) dispatches carry no exec key and are
+    not profiled."""
+    t0 = time.perf_counter()
+    try:
+        d = _PENDING.get()
+        if d is None:
+            d = deque(maxlen=8)
+            _PENDING.set(d)
+        d.append((key, time.perf_counter()))
+        with _LOCK:
+            if key in _COST:
+                _COUNTERS["reuses_total"] += 1
+            rt = _RUNTIME.get(key)
+            if rt is None:
+                rt = _RUNTIME[key] = {
+                    "dispatches": 0, "device_s": 0.0, "window_s": 0.0,
+                    "samples": deque(maxlen=_OCC_SAMPLES),
+                }
+            rt["dispatches"] += 1
+    finally:
+        _account(t0)
+
+
+def note_device(seconds: float) -> None:
+    """The device wait that retires the oldest in-flight dispatch
+    (engine's ``block_until_ready`` sites): close the enqueue→retire
+    window, attribute device seconds, and take one occupancy sample
+    against the key's cost model."""
+    t0 = time.perf_counter()
+    try:
+        d = _PENDING.get()
+        if not d:
+            with _LOCK:
+                _COUNTERS["unpaired_device_total"] += 1
+            return
+        key, t_enq = d.popleft()
+        window = max(t0 - t_enq, float(seconds), 1e-9)
+        pk = peaks()
+        with _LOCK:
+            cost = _COST.get(key)
+            rt = _RUNTIME.get(key)
+            if rt is None:
+                rt = _RUNTIME[key] = {
+                    "dispatches": 0, "device_s": 0.0, "window_s": 0.0,
+                    "samples": deque(maxlen=_OCC_SAMPLES),
+                }
+            rt["device_s"] += float(seconds)
+            rt["window_s"] += window
+            if cost:
+                occ_f = occ_b = None
+                if cost.get("flops"):
+                    occ_f = (cost["flops"] / window) / pk["flops"]
+                if cost.get("bytes_accessed"):
+                    occ_b = (cost["bytes_accessed"] / window) / pk["bytes_s"]
+                if occ_f is not None or occ_b is not None:
+                    rt["samples"].append((occ_f, occ_b))
+    finally:
+        _account(t0)
+
+
+def note_ledger_overrun() -> None:
+    """flight's ledger builder reports a components-exceed-wall ledger
+    here (the sums-to-wall invariant's failure counter)."""
+    with _LOCK:
+        _COUNTERS["ledger_overruns_total"] += 1
+
+
+# --------------------------------------------------------------------------
+# dispatch-gap series from span timestamps (ISSUE 15 trace linkage)
+# --------------------------------------------------------------------------
+
+
+def _dispatch_spans(span: dict, out: list) -> None:
+    if span.get("name") == "dispatch" and span.get("wall_s") is not None:
+        out.append((span["start_s"], span["start_s"] + span["wall_s"]))
+    for child in span.get("spans") or ():
+        _dispatch_spans(child, out)
+
+
+def observe_gaps(report: dict, trace_id: str | None = None) -> None:
+    """Derive the dispatch-gap series of one traced solve from its
+    span timestamps (gap = end of one ladder dispatch to the start of
+    the next) and land it in the exemplar histogram — the p99 gap's
+    trace_id resolves via ``GET /debug/solves/<id>``. Never raises."""
+    t0 = time.perf_counter()
+    try:
+        spans: list = []
+        _dispatch_spans(report.get("spans") or {}, spans)
+        spans.sort()
+        for (_s0, e0), (s1, _e1) in zip(spans, spans[1:]):
+            gap = s1 - e0
+            if gap >= 0:
+                GAP_HIST.observe("ladder", gap, trace_id=trace_id)
+    except Exception:
+        pass
+    finally:
+        _account(t0)
+
+
+def gap_snapshot() -> dict:
+    return GAP_HIST.snapshot()
+
+
+def gap_exemplars() -> list:
+    return GAP_HIST.exemplars("path")
+
+
+# --------------------------------------------------------------------------
+# snapshots: per-executable rows + per-bucket roofline
+# --------------------------------------------------------------------------
+
+_TAGS = {"lanes", "mega", "mega-lanes"}
+
+
+def _pct(sorted_vals: list, q: float):
+    if not sorted_vals:
+        return None
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return round(sorted_vals[i], 6)
+
+
+def _render_key(key: tuple) -> dict:
+    """Human fields from one ``(solver_key, arg_signature)`` exec-cache
+    key: the dispatch-path tag, engine/scorer, device count, and the
+    bucket dims (trailing two dims of the largest-rank leaf shape —
+    the padded [P, R] every bucket shape ends with)."""
+    solver_key, arg_sig = key
+    tag = "single"
+    engine = scorer = None
+    ndev = chains = None
+    try:
+        if isinstance(solver_key[-1], str) and solver_key[-1] in _TAGS:
+            tag = solver_key[-1]
+        ndev = len(solver_key[0])
+        chains = int(solver_key[1])
+        engine, scorer = solver_key[3], solver_key[4]
+    except Exception:
+        pass
+    bucket = None
+    try:
+        big = max((s for s, _dt in arg_sig), key=len)
+        if len(big) >= 2:
+            bucket = [int(big[-2]), int(big[-1])]
+    except Exception:
+        pass
+    kid = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+    return {"key_id": kid, "path": tag, "engine": engine,
+            "scorer": scorer, "devices": ndev, "chains": chains,
+            "bucket": bucket}
+
+
+def snapshot() -> dict:
+    """Full observatory state: per-executable roofline rows (cost model
+    + measured totals + occupancy percentiles), counters, peaks, and
+    the profiler's own overhead accounting."""
+    pk = peaks()
+    with _LOCK:
+        cost = {k: dict(v) for k, v in _COST.items()}
+        runtime = {
+            k: {"dispatches": v["dispatches"],
+                "device_s": v["device_s"], "window_s": v["window_s"],
+                "samples": list(v["samples"])}
+            for k, v in _RUNTIME.items()
+        }
+        counters = dict(_COUNTERS)
+        ovh = dict(_OVERHEAD)
+    rows = []
+    for key in set(cost) | set(runtime):
+        c = cost.get(key) or {}
+        rt = runtime.get(key) or {}
+        row = {**_render_key(key), **{
+            "flops": c.get("flops"),
+            "bytes_accessed": c.get("bytes_accessed"),
+            "peak_hbm_bytes": c.get("peak_hbm_bytes"),
+            "compile_s": c.get("compile_s"),
+            "dispatches": rt.get("dispatches", 0),
+            "device_s": round(rt.get("device_s", 0.0), 4),
+            "window_s": round(rt.get("window_s", 0.0), 4),
+        }}
+        win = rt.get("window_s") or 0.0
+        n = rt.get("dispatches") or 0
+        if win > 0 and n:
+            if c.get("flops"):
+                row["achieved_flops_s"] = round(c["flops"] * n / win, 1)
+                row["occupancy_flops"] = round(
+                    row["achieved_flops_s"] / pk["flops"], 6)
+            if c.get("bytes_accessed"):
+                row["achieved_bytes_s"] = round(
+                    c["bytes_accessed"] * n / win, 1)
+                row["occupancy_hbm"] = round(
+                    row["achieved_bytes_s"] / pk["bytes_s"], 6)
+        occ_f = sorted(s[0] for s in rt.get("samples", ()) if s[0] is not None)
+        occ_b = sorted(s[1] for s in rt.get("samples", ()) if s[1] is not None)
+        if occ_f:
+            row["occupancy_flops_p50"] = _pct(occ_f, 0.50)
+            row["occupancy_flops_p99"] = _pct(occ_f, 0.99)
+        if occ_b:
+            row["occupancy_hbm_p50"] = _pct(occ_b, 0.50)
+            row["occupancy_hbm_p90"] = _pct(occ_b, 0.90)
+            row["occupancy_hbm_p99"] = _pct(occ_b, 0.99)
+        rows.append(row)
+    rows.sort(key=lambda r: -(r.get("device_s") or 0.0))
+    if ovh["ops_total"]:
+        ovh["avg_op_s"] = round(
+            ovh["seconds_total"] / ovh["ops_total"], 9)
+    ovh["seconds_total"] = round(ovh["seconds_total"], 6)
+    return {"peaks": pk, "executables": rows, "counters": counters,
+            "overhead": ovh}
+
+
+def roofline() -> list:
+    """Per-bucket aggregation of :func:`snapshot` rows (the
+    ``/debug/profile`` table): executables grouped by bucket dims, with
+    summed device/window seconds and the occupancy of the dominant
+    (most device seconds) executable per bucket."""
+    snap = snapshot()
+    groups: dict = {}
+    for row in snap["executables"]:
+        bk = tuple(row["bucket"] or ())
+        g = groups.setdefault(bk, {
+            "bucket": row["bucket"], "executables": 0, "dispatches": 0,
+            "device_s": 0.0, "window_s": 0.0, "paths": [],
+        })
+        g["executables"] += 1
+        g["dispatches"] += row["dispatches"]
+        g["device_s"] = round(g["device_s"] + row["device_s"], 4)
+        g["window_s"] = round(g["window_s"] + row["window_s"], 4)
+        if row["path"] not in g["paths"]:
+            g["paths"].append(row["path"])
+        best = g.get("_best_dev", -1.0)
+        if row["device_s"] > best:
+            g["_best_dev"] = row["device_s"]
+            for f in ("occupancy_flops", "occupancy_hbm",
+                      "occupancy_hbm_p50", "occupancy_hbm_p99",
+                      "flops", "bytes_accessed", "peak_hbm_bytes"):
+                if row.get(f) is not None:
+                    g[f] = row[f]
+    out = []
+    for g in groups.values():
+        g.pop("_best_dev", None)
+        out.append(g)
+    out.sort(key=lambda g: -(g["device_s"] or 0.0))
+    return out
+
+
+def overhead() -> dict:
+    with _LOCK:
+        return dict(_OVERHEAD)
+
+
+# --------------------------------------------------------------------------
+# ledger aggregation (records in -> attribution out; shared by
+# /debug/profile and the offline kao-prof CLI)
+# --------------------------------------------------------------------------
+
+LEDGER_FIELDS = ("queue_wait_s", "constructor_s", "compile_s",
+                 "dispatch_gap_s", "device_s", "transfer_s",
+                 "boundary_s", "other_s")
+
+
+def attribution_summary(records: list) -> dict:
+    """Aggregate attribution over flight records carrying a ledger:
+    per-kind mean share of wall for every ledger component, plus the
+    sums-to-wall conformance count."""
+    per_kind: dict = {}
+    for rec in records:
+        led = rec.get("ledger")
+        if not isinstance(led, dict):
+            continue
+        wall = float(led.get("wall_s") or 0.0)
+        k = rec.get("kind") or "solve"
+        g = per_kind.setdefault(k, {
+            "solves": 0, "wall_s": 0.0, "ok": 0,
+            **{f: 0.0 for f in LEDGER_FIELDS},
+        })
+        g["solves"] += 1
+        g["wall_s"] += wall
+        g["ok"] += int(bool(led.get("ok")))
+        for f in LEDGER_FIELDS:
+            g[f] += float(led.get(f) or 0.0)
+    for g in per_kind.values():
+        wall = g["wall_s"]
+        g["shares"] = {
+            f: round(g[f] / wall, 4) if wall > 0 else None
+            for f in LEDGER_FIELDS
+        }
+        for f in LEDGER_FIELDS:
+            g[f] = round(g[f], 4)
+        g["wall_s"] = round(wall, 4)
+    return per_kind
+
+
+def worst_solves(records: list, n: int = 5) -> list:
+    """The n solves losing the most wall to non-device time (the
+    worst-attribution list): rows link by trace_id into
+    ``GET /debug/solves/<id>`` and the Perfetto export."""
+    rows = []
+    for rec in records:
+        led = rec.get("ledger")
+        if not isinstance(led, dict):
+            continue
+        wall = float(led.get("wall_s") or 0.0)
+        lost = wall - float(led.get("device_s") or 0.0)
+        rows.append({
+            "trace_id": rec.get("trace_id"),
+            "kind": rec.get("kind"),
+            "bucket": rec.get("bucket"),
+            "wall_s": round(wall, 4),
+            "lost_s": round(lost, 4),
+            "lost_share": round(lost / wall, 4) if wall > 0 else None,
+            "ledger": {f: led.get(f) for f in LEDGER_FIELDS},
+            "ok": bool(led.get("ok")),
+        })
+    rows.sort(key=lambda r: -r["lost_s"])
+    return rows[:n]
+
+
+# --------------------------------------------------------------------------
+# kao-prof CLI: offline attribution over flight JSONL dirs
+# --------------------------------------------------------------------------
+
+
+def _fmt_share(v) -> str:
+    return f"{100.0 * v:5.1f}%" if v is not None else "    --"
+
+
+def main(argv: list | None = None) -> int:
+    """``kao-prof``: wall-clock attribution over flight JSONL
+    files/dirs (or live worker URLs) — the offline view of
+    ``GET /debug/profile``. Multiple sources merge fleet-wide through
+    ``obs.fleet.merge_sources`` (seq-dedup, per-worker order)."""
+    ap = argparse.ArgumentParser(
+        prog="kao-prof",
+        description="offline wall-clock attribution + worst-solve "
+                    "report over flight JSONL dirs (fleet-wide when "
+                    "given several sources; docs/OBSERVABILITY.md "
+                    "'Reading a roofline')")
+    ap.add_argument("sources", nargs="+",
+                    help="flight JSONL file(s)/dir(s) or http(s) "
+                         "worker base URLs")
+    ap.add_argument("--kind", default=None,
+                    help="only records of this kind (solve/lane/delta)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="worst-attribution solves to list (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    from . import fleet as _fleet
+
+    try:
+        sources = [(s, _fleet.iter_source(s)) for s in args.sources]
+    except OSError as e:
+        # kao: disable=KAO106 -- CLI stderr diagnostic, not serve-path
+        print(f"kao-prof: {e}", file=sys.stderr)
+        return 2
+    records, per_worker, dups = _fleet.merge_sources(sources)
+    if args.kind:
+        records = [r for r in records if r.get("kind") == args.kind]
+    summary = attribution_summary(records)
+    worst = worst_solves(records, args.top)
+    out = {
+        "records": len(records),
+        "workers": len(per_worker),
+        "duplicates_dropped": dups,
+        "attribution": summary,
+        "worst_solves": worst,
+    }
+    if args.json:
+        print(json.dumps(out, indent=2))  # kao: disable=KAO106 -- CLI stdout is the product
+        return 0
+    print(f"{len(records)} records from {len(per_worker)} worker(s)"  # kao: disable=KAO106 -- CLI stdout is the product
+          + (f", {dups} duplicates dropped" if dups else ""))
+    for kind, g in sorted(summary.items()):
+        print(f"\n[{kind}] {g['solves']} solves, "  # kao: disable=KAO106 -- CLI stdout is the product
+              f"{g['wall_s']:.2f}s wall, ledgers ok "
+              f"{g['ok']}/{g['solves']}")
+        for f in LEDGER_FIELDS:
+            print(f"  {f:<15} {_fmt_share(g['shares'][f])} "  # kao: disable=KAO106 -- CLI stdout is the product
+                  f"({g[f]:.3f}s)")
+    if worst:
+        print("\nworst-attribution solves (most non-device wall):")  # kao: disable=KAO106 -- CLI stdout is the product
+        for row in worst:
+            print(f"  {row['lost_s']:7.3f}s lost / "  # kao: disable=KAO106 -- CLI stdout is the product
+                  f"{row['wall_s']:7.3f}s wall  "
+                  f"kind={row['kind']} trace={row['trace_id']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,9 +47,17 @@ slowed-by-``factor`` fixture CI uses to prove the gate actually trips
 (soak.yml): every latency field multiplied, every throughput field
 divided, quality untouched.
 
+**Efficiency is its own axis (ISSUE 18).** The bench ``profile`` block
+carries the roofline observatory's measured occupancy and ledger
+shares (obs.prof); a >= 2x occupancy collapse or a > 0.25 absolute
+device-share drop is confirmed on its own — walls can stay flat while
+the same work quietly doubles its device windows.
+``seed_occupancy_drop(artifact, factor)`` builds that gate's CI
+trip-wire fixture (walls untouched, occupancy divided).
+
 CLI: ``python -m kafka_assignment_optimizer_tpu.obs.regress OLD NEW``
 (exit 0 ok / 3 regression / 4 incomparable), or
-``--seed-slowdown F IN OUT``.
+``--seed-slowdown F IN OUT`` / ``--seed-occupancy-drop F IN OUT``.
 """
 
 from __future__ import annotations
@@ -59,7 +67,8 @@ import json
 import sys
 from pathlib import Path
 
-__all__ = ["compare", "seed_slowdown", "load_artifact", "main"]
+__all__ = ["compare", "seed_slowdown", "seed_occupancy_drop",
+           "load_artifact", "main"]
 
 DEFAULT_SOFT_RATIO = 1.6
 DEFAULT_HARD_RATIO = 2.5
@@ -282,6 +291,15 @@ def _throughput_pairs(old: dict,
         new.get("megachunk_ab") or {}
     add("megachunk_ab.dispatch_reduction", oma.get("dispatch_reduction"),
         nma.get("dispatch_reduction"))
+    # roofline occupancy (obs.prof, ISSUE 18): achieved/peak of the
+    # dominant executable. Ratios between same-env artifacts are
+    # meaningful even though the absolute peak is configurable; higher
+    # is better. The ratio check here catches drift; a >= 2x collapse
+    # is additionally CONFIRMED in _quality_regressions (the seeded
+    # occupancy-halving fixture must trip without a quorum).
+    opf, npf = old.get("profile") or {}, new.get("profile") or {}
+    for k in ("occupancy_hbm", "occupancy_flops"):
+        add(f"profile.{k}", opf.get(k), npf.get(k))
     return pairs
 
 
@@ -299,6 +317,7 @@ _DETERMINISTIC_KEYS = (
     ("fleet", ("affinity_ok", "quality_ok", "spread_ok", "dropped")),
     ("decompose", ("stitched_feasible", "gap_ok")),
     ("megachunk_ab", ("parity_ok", "feasible_mega")),
+    ("profile", ("ledger_ok",)),
 )
 
 
@@ -422,6 +441,31 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
         if oma.get(k) is True and nma.get(k) is False:
             regs.append({"metric": f"megachunk_ab.{k}",
                          "old": True, "new": False})
+    # efficiency regressions (obs.prof, ISSUE 18): occupancy collapsing
+    # to half or worse is confirmed on its own — walls can stay flat
+    # while the same work suddenly needs 2x the device windows (a
+    # de-fused scan, a broken donation) and the latency quorum would
+    # miss it. An attribution share shift (device share of wall falling
+    # by > 0.25 absolute) is the same failure seen from the ledger
+    # side. Tiny occupancies are excluded: below 1e-6 the ratio of two
+    # measurement artifacts is noise, not evidence. The ledger
+    # sums-to-wall conformance bit is deterministic like any parity.
+    opf, npf = old.get("profile") or {}, new.get("profile") or {}
+    for k in ("occupancy_hbm", "occupancy_flops"):
+        ov, nv = opf.get(k), npf.get(k)
+        if (isinstance(ov, (int, float)) and isinstance(nv, (int, float))
+                and ov > 1e-6 and nv > 0 and ov / nv >= 2.0):
+            regs.append({"metric": f"profile.{k}_collapse",
+                         "old": ov, "new": nv,
+                         "ratio": round(ov / nv, 3)})
+    ods, nds = opf.get("device_share"), npf.get("device_share")
+    if (isinstance(ods, (int, float)) and isinstance(nds, (int, float))
+            and ods - nds > 0.25):
+        regs.append({"metric": "profile.device_share_shift",
+                     "old": ods, "new": nds})
+    if opf.get("ledger_ok") is True and npf.get("ledger_ok") is False:
+        regs.append({"metric": "profile.ledger_ok",
+                     "old": True, "new": False})
     return regs
 
 
@@ -561,6 +605,44 @@ def seed_slowdown(artifact: dict, factor: float) -> dict:
     if isinstance(dc, dict):
         scale(dc, "ultra_jumbo_cold_s", f)
         scale(dc, "decompose_speedup", 1.0 / f)
+    pf = art.get("profile")
+    if isinstance(pf, dict):
+        # a uniform slowdown stretches every device window, so the
+        # achieved occupancy falls by the same factor (flops/window
+        # against an unchanged peak)
+        for k in ("occupancy_hbm", "occupancy_flops"):
+            scale(pf, k, 1.0 / f)
+    return art
+
+
+def seed_occupancy_drop(artifact: dict, factor: float) -> dict:
+    """A synthetic copy of ``artifact`` whose roofline occupancy
+    collapsed by ``factor`` with every wall clock UNTOUCHED — the
+    efficiency regression the latency quorum cannot see (the same work
+    suddenly costing ``factor``x the device windows). CI's trip-wire
+    fixture for the ISSUE 18 efficiency gate: ``factor`` >= 2 must
+    trip exit 3 via the confirmed ``profile.*_collapse`` check."""
+    art = json.loads(json.dumps(artifact))
+    f = float(factor)
+    pf = art.get("profile")
+    if isinstance(pf, dict):
+        for k in ("occupancy_hbm", "occupancy_flops",
+                  "occupancy_hbm_p50", "occupancy_hbm_p99"):
+            v = pf.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                pf[k] = round(v / f, 8)
+        # the ledger view of the same collapse: device share of wall
+        # shrinks toward zero as the lost time lands in other/gaps
+        ds = pf.get("device_share")
+        if isinstance(ds, (int, float)) and not isinstance(ds, bool):
+            pf["device_share"] = round(ds / f, 4)
+            shares = pf.get("ledger_shares")
+            if isinstance(shares, dict):
+                moved = ds - pf["device_share"]
+                shares["device_s"] = round(
+                    float(shares.get("device_s") or ds) / f, 4)
+                shares["other_s"] = round(
+                    float(shares.get("other_s") or 0.0) + moved, 4)
     return art
 
 
@@ -606,6 +688,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="instead of comparing: write a copy of OLD "
                          "slowed by FACTOR to NEW (the CI trip-wire "
                          "fixture)")
+    ap.add_argument("--seed-occupancy-drop", type=float,
+                    metavar="FACTOR", default=None,
+                    help="instead of comparing: write a copy of OLD "
+                         "whose roofline occupancy collapsed by FACTOR "
+                         "(walls untouched) to NEW — the efficiency-"
+                         "gate trip-wire fixture (ISSUE 18)")
     args = ap.parse_args(argv)
     if args.old is None or args.new is None:
         ap.error("need OLD and NEW artifact paths")
@@ -615,6 +703,15 @@ def main(argv: list[str] | None = None) -> int:
         art = load_artifact(args.old)
         Path(args.new).write_text(
             json.dumps(seed_slowdown(art, args.seed_slowdown)) + "\n"
+        )
+        return 0
+    if args.seed_occupancy_drop is not None:
+        if args.seed_occupancy_drop <= 0:
+            ap.error("--seed-occupancy-drop must be > 0")
+        art = load_artifact(args.old)
+        Path(args.new).write_text(
+            json.dumps(seed_occupancy_drop(art, args.seed_occupancy_drop))
+            + "\n"
         )
         return 0
     return run_compare(args.old, args.new, force=args.force,

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analysis import sanitize as _san
 from ..obs import flight as _flight
+from ..obs import prof as _oprof
 from ..obs import trace as _otrace
 from ..resilience import budget as _rbudget
 from ..resilience import chaos as _chaos
@@ -108,6 +109,7 @@ def clear_exec_cache() -> None:
         _EXECUTABLES.clear()
     for key in dropped:
         _san.forget_key(key)  # post-clear compiles are cold, not thrash
+        _oprof.forget_key(key)  # cost models share the exec lifecycle
 
 
 # lane-consolidation ledger (ISSUE 10): which RAW batch widths each
@@ -220,8 +222,15 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                     _INFLIGHT[key] = threading.Event()
         if ex is not None:
             try:
+                td = time.perf_counter()
                 with _otrace.span("dispatch", cache="hit"):
                     out = ex(*args)
+                # ledger dispatch leaf (enqueue-only) + profiler
+                # pairing stamp: the engine's retire-side device wait
+                # closes this dispatch's occupancy window
+                _flight.note_window("dispatch",
+                                    time.perf_counter() - td)
+                _oprof.note_dispatch(key)
                 _CACHE_STATS.record_exec(True)
                 _flight.note_dispatch("hit")
                 return out
@@ -229,6 +238,7 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                 with _EXECUTABLES_LOCK:
                     _EXECUTABLES.pop(key, None)
                 _san.forget_key(key)  # its next compile is a rebuild
+                _oprof.forget_key(key)
                 if not _args_alive(args):
                     # a donating executable consumed its buffers before
                     # failing — the jit retry cannot run on dead args
@@ -236,8 +246,16 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                 _CACHE_STATS.record_exec(False, fallback=True)
                 _flight.note_dispatch("fallback")
                 _ladder.note_rung("aot_to_jit", cause="exec_failed")
-                with _otrace.span("dispatch", cache="fallback"):
-                    return fn(*args)
+                td = time.perf_counter()
+                try:
+                    with _otrace.span("dispatch", cache="fallback"):
+                        return fn(*args)
+                finally:
+                    # jit-fallback enqueue (tracing+compile inclusive)
+                    # is dispatch machinery cost; no exec key — the
+                    # profiler's roofline skips unprofiled dispatches
+                    _flight.note_window("dispatch",
+                                        time.perf_counter() - td)
         if inflight is None:
             break  # this thread owns the compile
         # another thread is compiling this exact key: wait for it, then
@@ -248,8 +266,13 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             _CACHE_STATS.record_exec(False, fallback=True)
             _flight.note_dispatch("fallback")
             _ladder.note_rung("aot_to_jit", cause="compile_wedged")
-            with _otrace.span("dispatch", cache="fallback"):
-                return fn(*args)
+            td = time.perf_counter()
+            try:
+                with _otrace.span("dispatch", cache="fallback"):
+                    return fn(*args)
+            finally:
+                _flight.note_window("dispatch",
+                                    time.perf_counter() - td)
     t0 = time.perf_counter()
     try:
         try:
@@ -263,8 +286,17 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             # past its budget means executable thrash — fail the solve
             # rather than paying 26-68 s per request silently
             _san.note_compile(key)
+            # cost-model capture (obs.prof): the XLA cost/memory
+            # analysis is compile-time state, captured ONCE here and
+            # cached under the exec-cache key — every warm dispatch
+            # reuses it with zero recomputation
+            _oprof.note_cost_model(key, ex, time.perf_counter() - t0)
             with _otrace.span("dispatch", cache="miss"):
                 out = ex(*args)
+            # no separate dispatch window on first contact: the
+            # enqueue is inside compile_s below (note_compile), and
+            # splitting it out would double-count the ledger's leaves
+            _oprof.note_dispatch(key)
         except _san.SanitizerError:
             raise  # a tripped sentinel must fail the solve, not fall back
         except Exception:
@@ -273,8 +305,13 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
             _CACHE_STATS.record_exec(False, fallback=True)
             _flight.note_dispatch("fallback")
             _ladder.note_rung("aot_to_jit", cause="compile_failed")
-            with _otrace.span("dispatch", cache="fallback"):
-                return fn(*args)
+            td = time.perf_counter()
+            try:
+                with _otrace.span("dispatch", cache="fallback"):
+                    return fn(*args)
+            finally:
+                _flight.note_window("dispatch",
+                                    time.perf_counter() - td)
         compile_s = time.perf_counter() - t0
         _CACHE_STATS.record_exec(False, compile_s=compile_s)
         # per-solve attribution (obs.flight): the ambient accumulator
@@ -289,8 +326,10 @@ def _dispatch(fn, solver_key: tuple, args: tuple):
                 evicted.append(_EXECUTABLES.popitem(last=False)[0])
         for old in evicted:
             # LRU eviction makes the key's next compile legitimate —
-            # the sanitizer's recompile sentinel must not count it
+            # the sanitizer's recompile sentinel must not count it,
+            # and the cost-model cache follows the same lifecycle
             _san.forget_key(old)
+            _oprof.forget_key(old)
         return out
     finally:
         with _EXECUTABLES_LOCK:
@@ -869,25 +908,35 @@ def fetch_global(x):
     One transient-fault retry (jittered backoff): a dropped transfer on
     a tunneled device is recoverable and must not abandon a multi-chunk
     anneal; the ``transfer_retry`` ladder rung records it."""
-    with _otrace.span("device_transfer"):
-        try:
-            _chaos.raise_if("device_transfer")
-            return _fetch_once(x)
-        except Exception as e:
-            if not _transfer_retryable(e):
-                raise
-            if jax.process_count() != 1:
-                # multi-controller: the fault was observed by THIS
-                # process only — peers may have completed their
-                # allgather, and a second collective issued from one
-                # process desynchronizes the SPMD program order (the
-                # engine holds the same workers-must-agree line for
-                # its fallbacks), so the fault surfaces instead of
-                # earning a local retry
-                raise
-            _ladder.note_rung("transfer_retry", error=repr(e)[:200])
-            time.sleep(_rbudget.backoff_s(0, base_s=0.05, cap_s=0.5))
-            return _fetch_once(x)
+    tt = time.perf_counter()
+    try:
+        with _otrace.span("device_transfer"):
+            return _fetch_guarded(x)
+    finally:
+        # ledger transfer leaf: counted once even inside a boundary
+        # window (obs.flight.attribute nets leaves out of nests)
+        _flight.note_window("transfer", time.perf_counter() - tt)
+
+
+def _fetch_guarded(x):
+    try:
+        _chaos.raise_if("device_transfer")
+        return _fetch_once(x)
+    except Exception as e:
+        if not _transfer_retryable(e):
+            raise
+        if jax.process_count() != 1:
+            # multi-controller: the fault was observed by THIS
+            # process only — peers may have completed their
+            # allgather, and a second collective issued from one
+            # process desynchronizes the SPMD program order (the
+            # engine holds the same workers-must-agree line for
+            # its fallbacks), so the fault surfaces instead of
+            # earning a local retry
+            raise
+        _ladder.note_rung("transfer_retry", error=repr(e)[:200])
+        time.sleep(_rbudget.backoff_s(0, base_s=0.05, cap_s=0.5))
+        return _fetch_once(x)
 
 
 class _AsyncFetch:

@@ -153,6 +153,7 @@ from .obs import drift as _odrift
 from .obs import expo as _expo
 from .obs import flight as _oflight
 from .obs import log as _olog
+from .obs import prof as _oprof
 from .obs import sampler as _osampler
 from .obs import slo as _oslo
 from .obs import trace as _otrace
@@ -366,7 +367,7 @@ def _profile_dir_for(bucket_key: tuple, trace_id: str | None) -> str | None:
 
 
 class _QueueItem:
-    __slots__ = ("fn", "done", "result", "exc", "abandoned")
+    __slots__ = ("fn", "done", "result", "exc", "abandoned", "enq")
 
     def __init__(self, fn):
         self.fn = fn
@@ -374,6 +375,9 @@ class _QueueItem:
         self.result = None
         self.exc: BaseException | None = None
         self.abandoned = False
+        # enqueue timestamp: the worker differences it at pickup so the
+        # flight ledger's queue-wait share is measured, not inferred
+        self.enq = time.perf_counter()
 
 
 class _SolveQueue:
@@ -447,6 +451,11 @@ class _SolveQueue:
             while self._draining:
                 self._cv.wait()
             self._active += 1
+        # queue-wait tagging (obs/flight ledger): everything between the
+        # submit's enqueue and this pickup — including a maintenance
+        # drain hold — is time the REQUEST waited, attributed to the
+        # solve this worker is about to run
+        qw_tok = _oflight.set_queue_wait(time.perf_counter() - item.enq)
         try:
             try:
                 item.result = item.fn()
@@ -454,6 +463,7 @@ class _SolveQueue:
                 item.exc = e
             item.done.set()
         finally:
+            _oflight.reset_queue_wait(qw_tok)
             with self._cv:
                 self._active -= 1
                 self._done_count += 1
@@ -931,6 +941,15 @@ def render_metrics() -> str:
     snap["breaker_tracked_keys"] = brk["tracked"]
     snap["breaker_trips_total"] = brk["trips_total"]
     snap["chaos_armed"] = _chaos.snapshot()["armed"]
+    # roofline-observatory scalars (obs.prof): cost-model capture and
+    # pairing health, ledger-overrun tripwire, and the profiler's own
+    # self-accounted overhead (the <2% invariant's numerator)
+    psnap = _oprof.snapshot()
+    for k, v in psnap["counters"].items():
+        snap[f"prof_{k}"] = v
+    snap["prof_executables"] = len(psnap["executables"])
+    snap["prof_overhead_seconds_total"] = psnap["overhead"][
+        "seconds_total"]
     lines = []
     for k, v in snap.items():
         name = f"kao_{k}"
@@ -1093,6 +1112,40 @@ def render_metrics() -> str:
     # the two surfaces cannot drift (obs.trace.trace_families)
     for fam in _otrace.trace_families():
         lines.extend(_expo.family_lines(*fam))
+    # roofline observatory (obs.prof, docs/OBSERVABILITY.md "Reading a
+    # roofline"): per-executable achieved/peak occupancy + measured
+    # device seconds, keyed by the exec-cache identity hash — the
+    # /debug/profile table's scrapeable projection
+    lines.append("# HELP kao_prof_occupancy achieved/peak occupancy "
+                 "per executable and dimension (obs.prof; ratios, "
+                 "peak from KAO_PROF_PEAK_*)")
+    lines.append("# TYPE kao_prof_occupancy gauge")
+    for row in psnap["executables"]:
+        for dim, f in (("flops", "occupancy_flops"),
+                       ("hbm", "occupancy_hbm")):
+            if row.get(f) is not None:
+                lines.append(
+                    f'kao_prof_occupancy{{key="{row["key_id"]}",'
+                    f'path="{row["path"]}",dim="{dim}"}} {row[f]}'
+                )
+    lines.append("# HELP kao_prof_device_seconds_total measured "
+                 "device seconds per executable (obs.prof)")
+    lines.append("# TYPE kao_prof_device_seconds_total counter")
+    for row in psnap["executables"]:
+        lines.append(
+            f'kao_prof_device_seconds_total{{key="{row["key_id"]}",'
+            f'path="{row["path"]}"}} {row["device_s"]}'
+        )
+    # dispatch-gap histogram: host time between consecutive ladder
+    # dispatches, derived from solve-report span timestamps; the
+    # exemplar sidecar links the p99 gap to its trace
+    _render_histogram(
+        lines, "kao_prof_dispatch_gap_seconds", "path",
+        _oprof.gap_snapshot(),
+        "host gap between consecutive ladder dispatches (obs.prof)",
+    )
+    _render_exemplars(lines, "kao_prof_dispatch_gap_seconds_exemplar",
+                      "path", _oprof.gap_exemplars())
     # build identity (satellite, ISSUE 9): which code/runtime produced
     # every number above — the first thing to check when two scrapes
     # disagree
@@ -2307,6 +2360,30 @@ def handle_debug_slo() -> dict:
     }
 
 
+def handle_debug_profile() -> dict:
+    """GET /debug/profile — the continuous roofline observatory
+    (docs/OBSERVABILITY.md "Reading a roofline"): per-bucket
+    achieved-vs-peak roofline from the cached XLA cost analyses,
+    wall-clock attribution aggregated from the flight ledgers, the
+    worst-attribution solves (trace_id links into /debug/solves/<id>),
+    and the dispatch-gap histogram with p99 exemplars."""
+    recent = _oflight.recent()
+    psnap = _oprof.snapshot()
+    return {
+        "peaks": psnap["peaks"],
+        "roofline": _oprof.roofline(),
+        "executables": psnap["executables"],
+        "attribution": _oprof.attribution_summary(recent),
+        "worst_solves": _oprof.worst_solves(recent),
+        "dispatch_gaps": {
+            "histogram": _oprof.gap_snapshot(),
+            "exemplars": _oprof.gap_exemplars(),
+        },
+        "counters": psnap["counters"],
+        "overhead": psnap["overhead"],
+    }
+
+
 def handle_fleet_get() -> dict:
     """GET /debug/fleet — this worker's record ring merged with the
     recent streams of the --fleet-peers workers (obs.fleet): one
@@ -2905,6 +2982,12 @@ class Handler(BaseHTTPRequestHandler):
             # and the tail of the flight-record stream
             # (docs/OBSERVABILITY.md)
             self._send(200, handle_debug_slo())
+        elif route == "/debug/profile":
+            # the roofline observatory: per-bucket achieved-vs-peak
+            # occupancy from cached XLA cost analyses + wall-clock
+            # attribution over the flight ledgers (docs/OBSERVABILITY.md
+            # "Reading a roofline")
+            self._send(200, handle_debug_profile())
         elif route == "/debug/fleet":
             # the merged fleet view: this worker + --fleet-peers
             # (docs/OBSERVABILITY.md "Fleet plane"); peer failures

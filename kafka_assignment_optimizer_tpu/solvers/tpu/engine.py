@@ -1583,6 +1583,7 @@ def _run_ladder(
                 tw = time.perf_counter()
                 jax.block_until_ready(pop_a)
                 device_s = time.perf_counter() - tw
+                _flight.note_device(device_s)
                 if engine == "sweep":
                     # commit only after the sync: a failed dispatch
                     # (e.g. Mosaic lowering, retried on XLA) must not
@@ -1591,7 +1592,9 @@ def _run_ladder(
                 h = retire_common(i, pop_a, pop_k, curve, disp_s,
                                   device_s, time.perf_counter() - tc, fb)
                 chunk_attrs(_sp, i, disp_s, device_s, 0.0, h, r.scorer)
-            if boundary(i):
+            with _flight.attribute("boundary"):
+                stop = boundary(i)
+            if stop:
                 return
             dl = _deadline_now()
             if dl is not None and time.perf_counter() > dl:
@@ -1640,13 +1643,15 @@ def _run_ladder(
                 tw = time.perf_counter()
                 jax.block_until_ready(pop_a)
                 device_s = time.perf_counter() - tw
+                _flight.note_device(device_s)
                 sweep_state = new_state  # synced: commit
                 now = time.perf_counter()
                 h = retire_common(i, pop_a, pop_k, curve, disp_s,
                                   device_s, now - t_mark, pend_fb)
                 t_mark = now
                 tb = time.perf_counter()
-                stop = boundary(i)
+                with _flight.attribute("boundary"):
+                    stop = boundary(i)
                 boundary_s = time.perf_counter() - tb
                 overlap = boundary_s if nxt is not None else 0.0
                 r.boundary_overlap_s += overlap
@@ -1807,6 +1812,7 @@ def _run_ladder(
         tw = time.perf_counter()
         jax.block_until_ready(pop_a)
         device_s = time.perf_counter() - tw
+        _flight.note_device(device_s)
         sweep_state = new_state
         n_exec, early = _read_exec(execd, k, armed)
         r.pop_a, r.pop_k = pop_a, pop_k
@@ -1890,7 +1896,9 @@ def _run_ladder(
                 mega_attrs(_sp, k, n_exec, armed, early, disp_s,
                            device_s)
             if early:
-                if _certify_exit(*certs):
+                with _flight.attribute("boundary"):
+                    certified = _certify_exit(*certs)
+                if certified:
                     return
                 # the device flagged an exit the host could not
                 # certify: the remaining fused groups would flag again
@@ -1899,7 +1907,9 @@ def _run_ladder(
                 # tiers) from the first unexecuted chunk
                 _drain(i + n_exec, None, to_pipelined=False)
                 return
-            if boundary(i + k - 1):
+            with _flight.attribute("boundary"):
+                stop = boundary(i + k - 1)
+            if stop:
                 return
             dl = _deadline_now()
             if dl is not None and time.perf_counter() > dl:
@@ -1958,13 +1968,16 @@ def _run_ladder(
                 )
                 t_mark = time.perf_counter()
                 tb = time.perf_counter()
-                stop = early or boundary(i + k - 1)
+                with _flight.attribute("boundary"):
+                    stop = early or boundary(i + k - 1)
                 if nxt is not None:
                     r.boundary_overlap_s += time.perf_counter() - tb
                 mega_attrs(_sp, k, n_exec, armed, early, disp_s,
                            device_s)
             if early:
-                if _certify_exit(*certs):
+                with _flight.attribute("boundary"):
+                    certified = _certify_exit(*certs)
+                if certified:
                     return  # in-flight speculation abandoned unread
                 if nxt is not None:
                     # adopt the in-flight group, then hand the tail to
@@ -1975,8 +1988,11 @@ def _run_ladder(
                         time.perf_counter() - t_mark,
                     )
                     t_mark = time.perf_counter()
-                    if early2 and _certify_exit(*certs2):
-                        return
+                    if early2:
+                        with _flight.attribute("boundary"):
+                            certified2 = _certify_exit(*certs2)
+                        if certified2:
+                            return
                     _drain(j + n2, None, to_pipelined=True)
                     return
                 _drain(i + n_exec, None, to_pipelined=True)
@@ -2437,38 +2453,46 @@ def _solve_tpu_inner(
         batch = rounds = steps_per_round = 0
         steps_per_round_ignored = False
 
-    if certified_a is None:
-        with _otrace.span("seed") as _sp:
-            a_seed, resumed, warm_started = _pick_seed(
-                inst, lp_warm, lp_warm_extends, checkpoint, warm_start
-            )
-            if _sp is not None:
-                _sp.set(resumed_from_checkpoint=resumed,
-                        warm_started=warm_started,
-                        warm_start_extends_greedy=bool(lp_warm_extends))
-    else:
-        _otrace.mark("seed", skipped=True)
-        a_seed = certified_a  # never dispatched: the ladder is empty
-        resumed = False
-        # the delta path's adapted plan can BE the certified plan: the
-        # warm-certify race worker tags its win (docs/WATCH.md)
-        warm_started = getattr(inst, "_construct_path", None) == "warm"
-    # shape bucketing: lower the model padded up to its canonical bucket
-    # so every instance in the bucket reuses one set of jitted/AOT
-    # executables (solvers.tpu.bucket); padded rows are inert and every
-    # host-side oracle below sees plans sliced back to the real shape
-    if certified_a is None:
-        from . import bucket
+    # the ledger's host-constructor window: seed selection plus model
+    # construction — the host work that must finish before anything can
+    # be lowered or dispatched (obs/flight attribution; nested, so any
+    # leaf window accrued inside would be netted out, not double-counted)
+    with _flight.attribute("constructor"):
+        if certified_a is None:
+            with _otrace.span("seed") as _sp:
+                a_seed, resumed, warm_started = _pick_seed(
+                    inst, lp_warm, lp_warm_extends, checkpoint, warm_start
+                )
+                if _sp is not None:
+                    _sp.set(resumed_from_checkpoint=resumed,
+                            warm_started=warm_started,
+                            warm_start_extends_greedy=bool(lp_warm_extends))
+        else:
+            _otrace.mark("seed", skipped=True)
+            a_seed = certified_a  # never dispatched: the ladder is empty
+            resumed = False
+            # the delta path's adapted plan can BE the certified plan:
+            # the warm-certify race worker tags its win (docs/WATCH.md)
+            warm_started = getattr(inst, "_construct_path", None) == "warm"
+        # shape bucketing: lower the model padded up to its canonical
+        # bucket so every instance in the bucket reuses one set of
+        # jitted/AOT executables (solvers.tpu.bucket); padded rows are
+        # inert and every host-side oracle below sees plans sliced back
+        # to the real shape
+        if certified_a is None:
+            from . import bucket
 
-        bkt_parts, bkt_rf = bucket.bucket_shape(inst)
-        m = arrays.from_instance(inst, num_parts=bkt_parts, max_rf=bkt_rf)
-        bucket.STATS.record_bucket(
-            (inst.num_brokers, inst.num_racks, bkt_parts, bkt_rf),
-            padded=(bkt_parts, bkt_rf) != (inst.num_parts, inst.max_rf),
-        )
-    else:
-        m = None
-        bkt_parts = bkt_rf = None
+            bkt_parts, bkt_rf = bucket.bucket_shape(inst)
+            m = arrays.from_instance(inst, num_parts=bkt_parts,
+                                     max_rf=bkt_rf)
+            bucket.STATS.record_bucket(
+                (inst.num_brokers, inst.num_racks, bkt_parts, bkt_rf),
+                padded=(bkt_parts, bkt_rf) != (inst.num_parts,
+                                               inst.max_rf),
+            )
+        else:
+            m = None
+            bkt_parts = bkt_rf = None
     t_seed = time.perf_counter()
 
     if certified_a is None:
@@ -2626,6 +2650,7 @@ def _solve_tpu_inner(
             megachunk, _mega_sup, multi, len(chunks),
             (*warm_key, int(chunks[0].shape[0]), scorer),
         )
+        marks0 = _flight.ledger_marks()
         with _otrace.span("ladder", engine=engine,
                           chunks=len(chunks)) as _sp:
             lad = _run_ladder(
@@ -2650,13 +2675,27 @@ def _solve_tpu_inner(
                         dispatches=lad.dispatches,
                         megachunk_k=lad.mega_k)
         if engine == "sweep" and lad.dispatches:
-            # feed the fusion evidence table (KAO_MEGACHUNK=auto):
-            # per-dispatch host overhead vs per-chunk device time for
-            # this executable family — K=1 solves teach it too
+            # feed the fusion evidence table (KAO_MEGACHUNK=auto) from
+            # the attribution funnel's measured windows — the SAME
+            # dispatch/device leaves the solve ledger lands, differenced
+            # around the ladder, so the evidence table and the ledger
+            # can never disagree. Compile time is its own leaf, so the
+            # per-dispatch overhead here is compile-exclusive (the warm
+            # steady state fusion actually tunes for). Falls back to
+            # the ladder's own tallies when accounting is inactive.
+            marks1 = _flight.ledger_marks()
+            ev_n = marks1["dispatches"] - marks0["dispatches"]
+            if ev_n > 0:
+                ev_disp = marks1["dispatch_s"] - marks0["dispatch_s"]
+                ev_dev = marks1["device_s"] - marks0["device_s"]
+            else:
+                ev_n, ev_disp, ev_dev = (
+                    lad.dispatches, lad.dispatch_s, lad.device_s
+                )
             note_megachunk_evidence(
                 (*warm_key, int(chunks[0].shape[0]), lad.scorer),
-                dispatches=lad.dispatches, dispatch_s=lad.dispatch_s,
-                chunks=lad.chunks_exec, device_s=lad.device_s,
+                dispatches=ev_n, dispatch_s=ev_disp,
+                chunks=lad.chunks_exec, device_s=ev_dev,
             )
     else:
         # constructed fast path: the ladder never runs, and calling into
@@ -3383,6 +3422,7 @@ def _solve_batch_body(
                 tw = time.perf_counter()
                 jax.block_until_ready(pa)
                 device_s = time.perf_counter() - tw
+                _flight.note_device(device_s)
                 state = new_state
                 retire(ci, pa, pk, cv, disp_s, device_s,
                        time.perf_counter() - tc, fb, _sp, 0.0)
@@ -3436,6 +3476,7 @@ def _solve_batch_body(
                 tw = time.perf_counter()
                 jax.block_until_ready(pa)
                 device_s = time.perf_counter() - tw
+                _flight.note_device(device_s)
                 state = new_state
                 now = time.perf_counter()
                 retire(ci, pa, pk, cv, disp_s, device_s, now - t_mark,
@@ -3499,6 +3540,7 @@ def _solve_batch_body(
         tw = time.perf_counter()
         jax.block_until_ready(pa)
         device_s = time.perf_counter() - tw
+        _flight.note_device(device_s)
         state = new_state
         pop_a, pop_k = pa, pk
         mega_groups += 1
@@ -3609,6 +3651,7 @@ def _solve_batch_body(
             pending = nxt
             ci, k = cj, k_next
 
+    marks0 = _flight.ledger_marks()
     with _otrace.span("ladder", engine=engine,
                       chunks=len(chunks)) as _lsp:
         if engine == "sweep" and mega_k > 1 and n > 1:
@@ -3639,10 +3682,23 @@ def _solve_batch_body(
     if mega_warm_s is not None:
         _WARM_CHUNKS.update(_wkey(mega_k), mega_warm_s)
     if engine == "sweep" and dispatches:
+        # one accounting funnel (see the single path): the evidence
+        # table eats the ledger's own dispatch/device leaves differenced
+        # around the batch ladder, falling back to the ladder tallies
+        # when accounting is inactive
+        marks1 = _flight.ledger_marks()
+        ev_n = marks1["dispatches"] - marks0["dispatches"]
+        if ev_n > 0:
+            ev_disp = marks1["dispatch_s"] - marks0["dispatch_s"]
+            ev_dev = marks1["device_s"] - marks0["device_s"]
+        else:
+            ev_n, ev_disp, ev_dev = (
+                dispatches, dispatch_s_total, device_s_total
+            )
         note_megachunk_evidence(
             (*warm_key, chunk_len, scorer),
-            dispatches=dispatches, dispatch_s=dispatch_s_total,
-            chunks=chunks_exec, device_s=device_s_total,
+            dispatches=ev_n, dispatch_s=ev_disp,
+            chunks=chunks_exec, device_s=ev_dev,
         )
     t_solve = time.perf_counter()
 

@@ -546,6 +546,113 @@ def test_kao113_host_sync_in_scan_body():
     assert "KAO113" not in _rules(_lint(sup))
 
 
+# ---------------------------------------------------------------- KAO114
+
+POS_114 = """
+    import time
+
+    def run_chunk(dispatch, state, log):
+        t0 = time.perf_counter()
+        out = dispatch(state)
+        dt = time.perf_counter() - t0
+        log.info("chunk", seconds=dt)  # the ledger never sees this
+        return out
+"""
+
+NEG_114_FUNNEL = """
+    import time
+
+    def run_chunk(dispatch, state, _flight):
+        t0 = time.perf_counter()
+        out = dispatch(state)
+        dt = time.perf_counter() - t0
+        _flight.note_window("dispatch", dt)
+        return out
+"""
+
+NEG_114_RESULT_FIELD = """
+    import time
+
+    def run_chunk(dispatch, state, r):
+        t0 = time.perf_counter()
+        out = dispatch(state)
+        r.device_s += time.perf_counter() - t0  # lands on the record
+        return out, time.perf_counter() - t0  # returned: caller funnels
+"""
+
+NEG_114_CHAIN = """
+    import time
+
+    def run_chunk(dispatch, state, overlap_ok, sp):
+        t0 = time.perf_counter()
+        out = dispatch(state)
+        dt = time.perf_counter() - t0
+        overlap = dt if overlap_ok else 0.0  # taint follows the chain
+        chunk_attrs(sp, overlap)
+        return out
+"""
+
+NEG_114_HEADROOM = """
+    import time
+
+    def run_chunk(dispatch, state, deadline):
+        if deadline - time.perf_counter() < 0.1:  # remaining budget,
+            return None                           # not elapsed wall
+        return dispatch(state)
+"""
+
+NEG_114_NO_DISPATCH_SITE = """
+    import time
+
+    def tick(log):
+        t0 = time.perf_counter()
+        work()
+        dt = time.perf_counter() - t0
+        log.info("tick", seconds=dt)
+"""
+
+
+def test_kao114_time_delta_outside_funnel():
+    # the rule is path-scoped to the dispatch hot modules
+    assert "KAO114" in _rules(
+        _lint(POS_114, rel="solvers/tpu/engine.py")
+    )
+    assert "KAO114" in _rules(_lint(POS_114, rel="parallel/mesh.py"))
+    # out of scope: the same shape elsewhere is whatever-module's
+    # business, not the accounting funnel's
+    assert "KAO114" not in _rules(_lint(POS_114))
+    assert "KAO114" not in _rules(_lint(POS_114, rel="obs/flight.py"))
+    # deltas that reach the funnel (directly, via a result field or
+    # return, or through an assignment chain) are the sanctioned shape
+    assert "KAO114" not in _rules(
+        _lint(NEG_114_FUNNEL, rel="solvers/tpu/engine.py")
+    )
+    assert "KAO114" not in _rules(
+        _lint(NEG_114_RESULT_FIELD, rel="solvers/tpu/engine.py")
+    )
+    assert "KAO114" not in _rules(
+        _lint(NEG_114_CHAIN, rel="solvers/tpu/engine.py")
+    )
+    # deadline-headroom checks (timer on the RIGHT) are control flow
+    assert "KAO114" not in _rules(
+        _lint(NEG_114_HEADROOM, rel="solvers/tpu/engine.py")
+    )
+    # functions that never reach a dispatch/compile site are host
+    # helpers timing themselves — out of the ledger's jurisdiction
+    assert "KAO114" not in _rules(
+        _lint(NEG_114_NO_DISPATCH_SITE, rel="solvers/tpu/engine.py")
+    )
+    # suppressible with justification, like every rule
+    sup = POS_114.replace(
+        "dt = time.perf_counter() - t0",
+        "dt = time.perf_counter() - t0  "
+        "# kao: disable=KAO114 -- test-only instrumentation",
+    )
+    assert "KAO114" not in _rules(
+        _lint(sup, rel="solvers/tpu/engine.py")
+    )
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():
