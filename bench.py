@@ -183,11 +183,35 @@ def _env_stamp(platform: str, ndev: int | None, env: dict) -> dict:
             sha = r.stdout.strip()[:12] or None
     except (OSError, subprocess.TimeoutExpired):
         pass
+    # process topology (ISSUE 19 satellite): a 1-host artifact must
+    # never trip a bogus regression against a 2-host one, and a mesh
+    # with a different (chains x lanes) split is a different machine as
+    # far as per-dispatch numbers go. Best-effort: the parent process
+    # may not have jax importable/initialized — the stamp then carries
+    # the single-process defaults, which is exactly what the children
+    # run with.
+    n_procs, proc_idx = 1, 0
+    mesh_axes = None
+    jax = sys.modules.get("jax")  # never force the import: the parent
+    try:                          # probes platforms via children only
+        if jax is not None:
+            n_procs = int(jax.process_count())
+            proc_idx = int(jax.process_index())
+            from kafka_assignment_optimizer_tpu.parallel.mesh import (
+                mesh_snapshot,
+            )
+
+            mesh_axes = dict(mesh_snapshot()["axes"])
+    except Exception:
+        pass
     return {
         "git_sha": sha,
         "platform": platform,
         "devices": ndev,
         "xla_flags": env.get("XLA_FLAGS", ""),
+        "n_processes": n_procs,
+        "process_index": proc_idx,
+        "mesh_axes": mesh_axes,
     }
 
 
@@ -196,6 +220,7 @@ def _run_child(
     kernel: bool = False, batch_bench: bool = False,
     replay_day: bool = False, portfolio_bench: bool = False,
     rollout_bench: bool = False, decompose_bench: bool = False,
+    mesh_bench: bool = False,
 ) -> tuple[dict | None, str | None]:
     """Run one scenario in a child process; returns (result, error)."""
     cmd = [
@@ -216,6 +241,8 @@ def _run_child(
         cmd.append("--rollout-bench")
     if decompose_bench:
         cmd.append("--decompose-bench")
+    if mesh_bench:
+        cmd.append("--mesh-bench")
     if args.kernel and kernel:
         # the kernel micro-bench is headline-only: other children would
         # burn minutes producing output that is never emitted
@@ -1571,6 +1598,87 @@ def run_kernel_bench(smoke: bool) -> dict:
     return kernel_vs_xla(smoke=smoke)
 
 
+def run_mesh_bench(smoke: bool, seed: int) -> dict:
+    """``--mesh-bench`` (docs/MESH.md, ISSUE 19): the per-bucket
+    sharding search as an A/B harness. One mid bucket, every candidate
+    (chains × lanes) split timed through the REAL lane dispatch path,
+    each split's global winner checked bit-for-bit against the default
+    — the artifact is the lanes-per-second curve across mesh widths
+    plus the parity verdict. On a host whose cores are outnumbered by
+    the (virtual) devices the widths timeshare the same silicon and
+    throughput parity across specs is the EXPECTED result; the
+    artifact stamps that so --compare reads the curve correctly."""
+    from kafka_assignment_optimizer_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafka_assignment_optimizer_tpu import build_instance
+    from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+    from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+    from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+    from kafka_assignment_optimizer_tpu.utils import gen
+
+    n_dev = len(jax.devices())
+    lanes = 4 if smoke else 8
+    n_temps = 8 if smoke else 16
+    repeats = 1 if smoke else 2
+
+    insts = []
+    for i in range(lanes):
+        sc = gen.adversarial(n_brokers=32, n_topics_low=3,
+                             n_topics_high=3, parts_per_topic=10,
+                             seed=seed + i)
+        insts.append(build_instance(sc.current, sc.broker_list,
+                                    sc.topology))
+    ms = arrays.stack_models([arrays.from_instance(i) for i in insts])
+    lane_seeds = np.stack(
+        [np.asarray(greedy_seed(i), np.int32) for i in insts]
+    )
+    keys = jnp.stack(
+        [jax.random.PRNGKey(seed + i) for i in range(lanes)]
+    )
+    temps = arrays.geometric_temps(2.0, 0.02, n_temps)
+    bkt = (insts[0].num_brokers, insts[0].num_racks,
+           int(ms.a0.shape[-2]), int(ms.a0.shape[-1]))
+
+    pm.reset_mesh_adapt()
+    t0 = time.perf_counter()
+    results = pm.run_sharding_search(
+        ms, lane_seeds, keys, temps, n_devices=n_dev,
+        chains_per_device=2, bucket_key=bkt, repeats=repeats,
+    )
+    search_s = time.perf_counter() - t0
+    chosen = pm.choose_sharding(bkt, n_dev, lanes)
+    by_rate = {r["spec"]: r["lanes_per_s"] for r in results}
+    default_spec = f"{n_dev}x1"
+    best = max(results, key=lambda r: r["lanes_per_s"])
+    cores = os.cpu_count() or 1
+    return {
+        "n_devices": n_dev,
+        "lanes": lanes,
+        "bucket": "x".join(str(k) for k in bkt),
+        "specs": results,
+        "parity_ok": all(r["parity_vs_default"] for r in results),
+        "chosen": f"{chosen[0]}x{chosen[1]}",
+        "default_lanes_per_s": by_rate.get(default_spec),
+        "best_spec": best["spec"],
+        "best_lanes_per_s": best["lanes_per_s"],
+        "lane_scaling": (
+            best["lanes_per_s"] / by_rate[default_spec]
+            if by_rate.get(default_spec) else None
+        ),
+        "search_s": round(search_s, 3),
+        "search_evals": pm.mesh_counters()["search_evals"],
+        "host_cores": cores,
+        # virtual devices timesharing fewer cores than devices: spec
+        # throughput parity is expected, not a finding (docs/MESH.md)
+        "single_core_parity_expected": cores < n_dev,
+    }
+
+
 def child_main(args: argparse.Namespace) -> int:
     if args.replay_day:
         out = run_replay_day(args.smoke, args.seed)
@@ -1590,6 +1698,10 @@ def child_main(args: argparse.Namespace) -> int:
         return 0
     if args.decompose_bench:
         out = run_decompose_bench(args.smoke, args.seed)
+        print("RESULT " + json.dumps(out))
+        return 0
+    if args.mesh_bench:
+        out = run_mesh_bench(args.smoke, args.seed)
         print("RESULT " + json.dumps(out))
         return 0
     out = run_scenario(args.scenario, args.smoke, args.seed, args.warm)
@@ -1727,6 +1839,25 @@ def _compact_decompose(rd: dict | None, err: str | None) -> dict:
         "gap_ok", "cmp_parts", "decomposed_wall_s", "flat_wall_s",
         "decompose_speedup",
     )}
+
+
+def _compact_mesh(rm: dict | None, err: str | None) -> dict:
+    """The mesh block of the stdout line: the lanes-per-second curve
+    across (chains × lanes) splits, the bit-parity verdict, the
+    evidence-table choice, and the single-core-parity stamp — the
+    ISSUE 19 bench evidence, compare-gated by obs/regress.py."""
+    if rm is None:
+        return {"error": (err or "failed")[:120]}
+    out = {k: rm[k] for k in (
+        "n_devices", "lanes", "bucket", "parity_ok", "chosen",
+        "default_lanes_per_s", "best_spec", "best_lanes_per_s",
+        "lane_scaling", "search_s", "search_evals",
+        "single_core_parity_expected",
+    )}
+    # the full curve, compacted: spec -> lanes/s
+    out["curve"] = {r["spec"]: round(r["lanes_per_s"], 3)
+                    for r in rm.get("specs", ())}
+    return out
 
 
 def _compact_rollout(rr: dict | None, err: str | None) -> dict:
@@ -2013,6 +2144,18 @@ def main() -> int:
                          "one-line decompose artifact wired into "
                          "--compare regression keys (same exclusive "
                          "convention as --replay-day)")
+    ap.add_argument("--mesh-bench", action="store_true",
+                    help="run ONLY the sharded-mesh A/B harness "
+                         "(docs/MESH.md): the per-bucket sharding "
+                         "search over every (chains x lanes) split of "
+                         "one mid bucket through the real lane "
+                         "dispatch path — per-spec lanes/s, bit-"
+                         "parity verdict vs the default split, the "
+                         "evidence-table choice; emitted as a "
+                         "one-line mesh artifact wired into "
+                         "--compare regression keys (same exclusive "
+                         "convention as --replay-day). On CPU the "
+                         "child is forced to 8 virtual devices")
     ap.add_argument("--fleet-bench", action="store_true",
                     help="run ONLY the fleet-router harness "
                          "(docs/FLEET.md): spawn a kao-router + 2 "
@@ -2141,6 +2284,33 @@ def main() -> int:
         line = {"metric": "decompose_bench", "platform": platform,
                 "env": _env_stamp(platform, ndev, env),
                 "decompose": _compact_decompose(rd, ed)}
+        if tpu_err:
+            line["tpu_error"] = tpu_err[:200]
+        print(json.dumps(line))
+        return 0
+
+    if args.mesh_bench:
+        # standalone sharded-mesh harness (the soak mesh step's entry):
+        # one child, one dedicated stdout line — no scenario sweep. On
+        # CPU the split space is empty without virtual devices, so the
+        # child gets the same 8-device forcing the test suite uses.
+        try:
+            env, platform, tpu_err, ndev = resolve_backend()
+        except Exception as e:  # noqa: BLE001 - must emit something
+            print(json.dumps({"metric": "mesh_bench",
+                              "error": repr(e)[:300]}))
+            return 0
+        if platform == "cpu" and "xla_force_host_platform_device_count" \
+                not in env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=8")
+        rm, em = _run_child(args, "mesh_bench", env, warmrun=False,
+                            mesh_bench=True)
+        if rm is not None:
+            print("[bench] MESH " + json.dumps(rm), file=sys.stderr)
+        line = {"metric": "mesh_bench", "platform": platform,
+                "env": _env_stamp(platform, ndev, env),
+                "mesh_bench": _compact_mesh(rm, em)}
         if tpu_err:
             line["tpu_error"] = tpu_err[:200]
         print(json.dumps(line))

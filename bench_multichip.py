@@ -77,6 +77,7 @@ def main() -> int:
     from kafka_assignment_optimizer_tpu.parallel.mesh import (
         best_of,
         make_mesh,
+        mesh_snapshot,
         solve_on_mesh,
     )
     from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
@@ -150,6 +151,12 @@ def main() -> int:
         "weight_upper_bound": int(ub),
         "move_lower_bound": int(lb),
         "platform": jax.devices()[0].platform,
+        # process/mesh topology (docs/MESH.md): single-chain curves run
+        # the default chains-only split; a multi-process or lane-split
+        # artifact is incomparable to this one (obs/regress.py)
+        "n_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "mesh_axes": dict(mesh_snapshot()["axes"]),
         "note": (
             "virtual 8-device CPU mesh on a 1-core host: devices "
             "timeshare, so wall_s grows with n_devices HERE; on a real "

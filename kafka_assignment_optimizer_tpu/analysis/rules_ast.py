@@ -92,6 +92,18 @@ caught only dynamically, alignment- or platform-dependently):
   result field — never in a local-only computation. A delta that only
   feeds a log line or a branch is wall the ledger cannot see, and the
   sums-to-wall invariant quietly degrades into a growing ``other_s``.
+- **KAO115** implicit sharding and stale device snapshots in the mesh
+  hot modules (``parallel/``): the sharded-mesh contract (ISSUE 19,
+  docs/MESH.md) is that every ``shard_map``/``pjit`` dispatch site
+  states its placements explicitly — ``in_specs``/``out_specs`` (or
+  ``in_shardings``/``out_shardings``) — because an omitted spec lets
+  the partitioner choose replication and silently breaks the
+  sharded-vs-unsharded bit-parity replay. Also flags ``jax.devices()``
+  snapshots frozen where a later mesh rebuild cannot refresh them: a
+  module-scope assignment, a default-argument value, or a device list
+  captured from a ``make_*`` factory scope into the closure the
+  factory returns (the stale-mesh bug class — the per-bucket sharding
+  search rebuilds the mesh between solves).
 
 All rules are stdlib-``ast`` only and run in milliseconds over the whole
 package; precision is tuned so the CURRENT tree is clean (real findings
@@ -205,6 +217,7 @@ def lint_source(
     out += _rule_uninjected_http(tree, path, rel)
     out += _rule_scan_host_sync(tree, path)
     out += _rule_time_delta(tree, path, rel)
+    out += _rule_mesh_sharding(tree, path, rel)
     sup = parse_suppressions(text)
     return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
 
@@ -1242,3 +1255,143 @@ def _time_delta_findings(fn, path) -> list[Finding]:
         Finding("KAO114", path, ln, msg)
         for ln in sorted(set(immediate) | (pending - escaped))
     ]
+
+
+# ---------------------------------------------------------------- KAO115
+
+# the mesh hot modules: every shard_map/pjit here carries the
+# bit-parity sharding contract (ISSUE 19, docs/MESH.md)
+_MESH_HOT_MARKER = "parallel/"
+# dispatch wrappers and the kwargs that make their placements explicit
+_SHARDMAP_NAMES = {"shard_map", "_shard_map"}
+_PJIT_NAMES = {"pjit"}
+
+
+def _is_devices_call(node: ast.AST) -> bool:
+    """``jax.devices()`` / ``jax.local_devices()`` (or the bare names
+    when imported directly) — the device-list snapshot shapes."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if not d or d[-1] not in ("devices", "local_devices"):
+        return False
+    return len(d) == 1 or d[0] == "jax"
+
+
+def _devices_call_in(node: ast.AST) -> ast.Call | None:
+    for sub in ast.walk(node):
+        if _is_devices_call(sub):
+            return sub
+    return None
+
+
+def _rule_mesh_sharding(tree, path, rel) -> list[Finding]:
+    """Flag two mesh-contract hazards in the ``parallel/`` hot modules:
+
+    - ``shard_map``/``pjit`` call sites missing explicit placement
+      kwargs (``in_specs``+``out_specs`` for shard_map,
+      ``in_shardings``+``out_shardings`` for pjit): an omitted spec
+      lets the partitioner pick replication, and the sharded replay of
+      a bucket silently stops being bit-identical to the unsharded
+      trajectory (docs/MESH.md 'Parity contract');
+    - ``jax.devices()`` snapshots frozen across mesh rebuilds: a
+      module-scope assignment, a default-argument value, or a device
+      list bound in a ``make_*`` factory scope and read from a nested
+      def (the closure the factory returns). The per-bucket sharding
+      search rebuilds the mesh between solves, so any frozen list is
+      the stale-mesh bug class — call ``jax.devices()`` at dispatch
+      time or accept the mesh as a parameter."""
+    if _MESH_HOT_MARKER not in rel:
+        return []
+    out: list[Finding] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        d = _dotted(n.func)
+        name = d[-1] if d else ""
+        if name in _SHARDMAP_NAMES:
+            need = ("in_specs", "out_specs")
+        elif name in _PJIT_NAMES:
+            need = ("in_shardings", "out_shardings")
+        else:
+            continue
+        missing = [k for k in need if _kw(n, k) is None]
+        if missing:
+            out.append(Finding(
+                "KAO115", path, n.lineno,
+                f"{name}(...) without explicit "
+                f"{'/'.join(missing)}: implicit placements let the "
+                "partitioner choose replication and break the "
+                "sharded-vs-unsharded bit-parity contract "
+                "(docs/MESH.md); state every in/out sharding"))
+    # module-scope device snapshot: frozen at import, blind to every
+    # later mesh rebuild
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                and stmt.value is not None:
+            call = _devices_call_in(stmt.value)
+            if call is not None:
+                out.append(Finding(
+                    "KAO115", path, call.lineno,
+                    "jax.devices() snapshotted at module scope: the "
+                    "list freezes at import and a rebuilt mesh "
+                    "(make_mesh/make_solve_mesh) never sees it; call "
+                    "at dispatch time (docs/MESH.md)"))
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        for dflt in defaults:
+            call = _devices_call_in(dflt)
+            if call is not None:
+                out.append(Finding(
+                    "KAO115", path, call.lineno,
+                    f"jax.devices() in a default argument of "
+                    f"{fn.name}(): evaluated once at def time and "
+                    "frozen across mesh rebuilds (stale-mesh bug "
+                    "class); default to None and resolve inside the "
+                    "body (docs/MESH.md)"))
+        if not fn.name.lstrip("_").startswith("make"):
+            continue
+        # device lists bound in the factory scope...
+        dev_names: set[str] = set()
+        for node in _walk_own_scope(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and node.value is not None \
+                    and _devices_call_in(node.value) is not None:
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    for e in (getattr(t, "elts", None) or [t]):
+                        if isinstance(e, ast.Name):
+                            dev_names.add(e.id)
+        if not dev_names:
+            continue
+        # ...read from a nested def: the returned closure pins the
+        # snapshot for its whole lifetime
+        for inner in ast.walk(fn):
+            if inner is fn or not isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            shadowed = _bound_names(inner)
+            for node in ast.walk(inner):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in dev_names
+                    and node.id not in shadowed
+                ):
+                    out.append(Finding(
+                        "KAO115", path, node.lineno,
+                        f"device list '{node.id}' captured from the "
+                        f"enclosing {fn.name}() factory scope into a "
+                        "closure: the snapshot outlives every mesh "
+                        "rebuild (stale-mesh bug class); resolve "
+                        "devices per dispatch or take the mesh as a "
+                        "parameter (docs/MESH.md)"))
+    return out

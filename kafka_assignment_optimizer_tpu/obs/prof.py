@@ -389,14 +389,23 @@ def _render_key(key: tuple) -> dict:
     """Human fields from one ``(solver_key, arg_signature)`` exec-cache
     key: the dispatch-path tag, engine/scorer, device count, and the
     bucket dims (trailing two dims of the largest-rank leaf shape —
-    the padded [P, R] every bucket shape ends with)."""
+    the padded [P, R] every bucket shape ends with). Lane-split
+    dispatches carry spec-suffixed tags (``"lanes@4x2"``,
+    docs/MESH.md): the base tag renders as the path and the ``dcxdl``
+    split as its own field, so roofline rows group by dispatch shape
+    AND device layout."""
     solver_key, arg_sig = key
     tag = "single"
+    sharding = None
     engine = scorer = None
     ndev = chains = None
     try:
-        if isinstance(solver_key[-1], str) and solver_key[-1] in _TAGS:
-            tag = solver_key[-1]
+        last = solver_key[-1]
+        if isinstance(last, str):
+            base, _, spec = last.partition("@")
+            if base in _TAGS:
+                tag = base
+                sharding = spec or None
         ndev = len(solver_key[0])
         chains = int(solver_key[1])
         engine, scorer = solver_key[3], solver_key[4]
@@ -410,9 +419,9 @@ def _render_key(key: tuple) -> dict:
     except Exception:
         pass
     kid = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
-    return {"key_id": kid, "path": tag, "engine": engine,
-            "scorer": scorer, "devices": ndev, "chains": chains,
-            "bucket": bucket}
+    return {"key_id": kid, "path": tag, "sharding": sharding,
+            "engine": engine, "scorer": scorer, "devices": ndev,
+            "chains": chains, "bucket": bucket}
 
 
 def snapshot() -> dict:

@@ -79,6 +79,12 @@ DEFAULT_HARD_RATIO = 2.5
 MIN_MEANINGFUL_S = 0.02
 
 ENV_KEYS = ("platform", "devices", "xla_flags")
+# process-topology keys (ISSUE 19 satellite): compared only when BOTH
+# artifacts carry the key — artifacts stamped before the topology
+# fields existed must stay comparable against new ones — but a present
+# mismatch (1-host vs 2-host, or a different chains×lanes mesh split)
+# makes per-dispatch numbers incomparable, never a "regression"
+TOPOLOGY_KEYS = ("n_processes", "mesh_axes")
 
 
 def load_artifact(path: str) -> dict:
@@ -163,6 +169,11 @@ def _env_verdict(old: dict, new: dict, force: bool) -> tuple[bool, str]:
     mismatches = [
         f"{k}: {oe.get(k)!r} != {ne.get(k)!r}"
         for k in ENV_KEYS if oe.get(k) != ne.get(k)
+    ]
+    mismatches += [
+        f"{k}: {oe.get(k)!r} != {ne.get(k)!r}"
+        for k in TOPOLOGY_KEYS
+        if k in oe and k in ne and oe.get(k) != ne.get(k)
     ]
     if mismatches and not force:
         return False, (
@@ -300,6 +311,14 @@ def _throughput_pairs(old: dict,
     opf, npf = old.get("profile") or {}, new.get("profile") or {}
     for k in ("occupancy_hbm", "occupancy_flops"):
         add(f"profile.{k}", opf.get(k), npf.get(k))
+    # sharded-mesh A/B (docs/MESH.md): the best split's lane throughput
+    # ONLY — lane_scaling is best/default divided, and the per-spec
+    # curve points are correlated draws of the same run (quorum
+    # honesty, same reasoning as megachunk_speedup). Topology mismatch
+    # between artifacts is already an incomparability above.
+    omb, nmb = old.get("mesh_bench") or {}, new.get("mesh_bench") or {}
+    add("mesh_bench.best_lanes_per_s", omb.get("best_lanes_per_s"),
+        nmb.get("best_lanes_per_s"))
     return pairs
 
 
@@ -318,6 +337,7 @@ _DETERMINISTIC_KEYS = (
     ("decompose", ("stitched_feasible", "gap_ok")),
     ("megachunk_ab", ("parity_ok", "feasible_mega")),
     ("profile", ("ledger_ok",)),
+    ("mesh_bench", ("parity_ok",)),
 )
 
 
@@ -465,6 +485,15 @@ def _quality_regressions(old: dict, new: dict) -> list[dict]:
                      "old": ods, "new": nds})
     if opf.get("ledger_ok") is True and npf.get("ledger_ok") is False:
         regs.append({"metric": "profile.ledger_ok",
+                     "old": True, "new": False})
+    # sharded-mesh quality (ISSUE 19, docs/MESH.md): every candidate
+    # (chains × lanes) split replaying the default split bit-for-bit
+    # is the mesh's load-bearing contract — a parity flip means a
+    # collective or placement change altered the trajectory, a
+    # confirmed regression regardless of how the walls moved
+    omm, nmm = old.get("mesh_bench") or {}, new.get("mesh_bench") or {}
+    if omm.get("parity_ok") is True and nmm.get("parity_ok") is False:
+        regs.append({"metric": "mesh_bench.parity_ok",
                      "old": True, "new": False})
     return regs
 

@@ -3,21 +3,38 @@
 The reference is a single-process batch tool with one subprocess call and
 no distributed execution anywhere (``/root/reference/README.md:1-201``;
 SURVEY.md §2 "parallelism strategies"). The TPU-native scaling axes
-(BASELINE.json:5) are:
+(BASELINE.json:5, docs/MESH.md) are:
 
 - **candidate-batch data parallelism**: the chain population is sharded
-  over a 1-D ``('data',)`` mesh; every device anneals its own shard.
+  over the ``'chains'`` axis of an explicit 2-D ``('chains', 'lanes')``
+  named mesh; every device anneals its own shard. The ``'lanes'`` axis
+  (size 1 unless a per-bucket sharding decision says otherwise) splits
+  the portfolio/batch lane axis over devices, so one dispatch can trade
+  chain replicas for lane throughput without a second code path.
 - **ICI collectives in the hot loop**: once per round, ``pmax``/``psum``
   inside ``shard_map`` locate the globally best chain and clone it over
   each shard's worst chain (migration), so devices share discoveries
-  without host round-trips. The final plan selection is a host-side argmax
-  over the per-shard bests (a few KB).
+  without host round-trips. Under a lane split the migration collectives
+  run over ``('chains', 'cblk')`` — the mesh axis plus the in-shard
+  chain-block vmap axis — which spans exactly the logical chain shards
+  of the unsplit layout, so every sharding of a bucket replays the same
+  trajectory bit-for-bit (the parity contract, docs/MESH.md). The final
+  plan selection is a host-side argmax over the per-shard bests (a few
+  KB).
+- **Per-bucket sharding search**: the (chains × lanes) split is not
+  hand-written — ``choose_sharding`` consults an evidence table fed by
+  timed candidate dispatches (``run_sharding_search``) through the same
+  AOT executable cache and profiler funnel as production solves, in the
+  mold of ``engine.choose_megachunk_k``.
+  ``KAO_MESH_SHARDING=auto|<dc>x<dl>|off`` forces or disables it.
 - **Multi-host (DCN)**: after ``parallel.distributed.init_distributed``
   (CLI/serve ``--distributed``) ``jax.devices()`` is the GLOBAL device
-  set, so the same 1-D mesh spans hosts; XLA compiles the migration
+  set, so the same named mesh spans hosts; XLA compiles the migration
   collectives to ride ICI within a slice and DCN across hosts. Only the
   once-per-round few-KB winner broadcast ever crosses DCN — the design
-  keeps the hot loop on-chip.
+  keeps the hot loop on-chip. The sharding chooser stays at the default
+  split under multi-controller SPMD (per-process evidence must not fork
+  the program — same discipline as ``engine._resolve_megachunk``).
 
 Works identically on one real TPU, a v5e-8 slice, a multi-host pod
 slice, or the CPU test mesh
@@ -26,6 +43,8 @@ slice, or the CPU test mesh
 
 from __future__ import annotations
 
+import os
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -45,7 +64,14 @@ from ..resilience import ladder as _ladder
 from ..solvers.tpu.arrays import ModelArrays
 from ..solvers.tpu.bucket import STATS as _CACHE_STATS
 
-AXIS = "data"
+AXIS = "chains"
+AXIS_LANES = "lanes"
+# in-shard chain-block vmap axis (docs/MESH.md): under a lane split the
+# chain axis keeps its FULL logical shard count (= total devices) and
+# each device vmaps a block of dl chain shards; migration collectives
+# run over (AXIS, _CBLK) so they span the same logical shards as the
+# unsplit layout — the bit-parity contract rests on this.
+_CBLK = "cblk"
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs):
@@ -64,11 +90,327 @@ def _shard_map(fn, *, mesh, in_specs, out_specs):
                   check_rep=False)
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
+def make_mesh(n_devices: int | None = None,
+              lane_devices: int = 1) -> Mesh:
+    """Build the named solve mesh: ``lane_devices`` (dl) devices on the
+    lane axis, the rest on the chain axis — ``(dc, dl)`` with ``dc * dl
+    = n_devices``. The default ``dl = 1`` is layout-identical to the
+    historical 1-D chains-only mesh (same device order, same ``P(AXIS)``
+    placements), so every existing call site is unchanged."""
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    return Mesh(np.array(devs), (AXIS,))
+    n = len(devs)
+    dl = max(1, int(lane_devices))
+    if n % dl:
+        raise ValueError(
+            f"lane_devices={dl} does not divide device count {n}"
+        )
+    dc = n // dl
+    mesh = Mesh(np.array(devs).reshape(dc, dl), (AXIS, AXIS_LANES))
+    with _MESH_LOCK:
+        _MESH_STATE["axes"] = {AXIS: dc, AXIS_LANES: dl}
+    return mesh
+
+
+def mesh_spec(mesh: Mesh) -> tuple[int, int]:
+    """The ``(dc, dl)`` axis split of a solve mesh. Tolerates foreign
+    meshes (no lane axis → ``dl = 1``) so helper code can interrogate
+    any mesh it is handed."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dl = int(shape.get(AXIS_LANES, 1))
+    dc = int(shape.get(AXIS, mesh.devices.size // max(dl, 1)))
+    return dc, dl
+
+
+# ---------------------------------------------------------------------------
+# Per-bucket sharding search (docs/MESH.md). The (chains × lanes) axis
+# split is CHOSEN, not hand-written: candidate splits are timed through
+# the real ``solve_lanes`` dispatch path (AOT exec cache + profiler
+# funnel — occupancy and dispatch gaps are the cost signal) and the
+# winner persists in an evidence table keyed by bucket, in the mold of
+# ``engine.choose_megachunk_k``: the chooser NEVER guesses — it returns
+# the default chains-only split until a candidate has real evidence.
+
+MESH_ENV = "KAO_MESH_SHARDING"
+# evidence quorum: a spec competes only after this many timed solves
+# (search evaluations or production dispatches) stand behind it
+MESH_MIN_SOLVES = 2
+
+_MESH_LOCK = threading.Lock()
+# last-built mesh axis sizes (healthz/metrics) + running counters
+_MESH_STATE: dict = {"axes": {AXIS: 1, AXIS_LANES: 1}}
+_MESH_COUNTERS = {"search_evals": 0, "reshard_bytes": 0}
+# bucket key -> spec "dcxdl" -> {"solves", "device_s", "lanes"}
+_SHARD_EVIDENCE: dict[tuple, dict[str, dict]] = {}
+
+
+def _spec_str(spec: tuple[int, int]) -> str:
+    return f"{spec[0]}x{spec[1]}"
+
+
+def parse_mesh_sharding(val: str | None = None):
+    """Parse ``KAO_MESH_SHARDING`` (or an explicit ``val``):
+    ``("auto", None)`` | ``("off", None)`` | ``("spec", (dc, dl))`` |
+    ``("invalid", None)``. Invalid values degrade to the default split
+    (never crash a solve over an env typo) — the mesh snapshot surfaces
+    the raw value so the typo is auditable."""
+    if val is None:
+        val = os.environ.get(MESH_ENV, "auto")
+    v = str(val).strip().lower()
+    if v in ("", "auto"):
+        return ("auto", None)
+    if v in ("off", "0", "none", "false"):
+        return ("off", None)
+    m = re.fullmatch(r"(\d+)x(\d+)", v)
+    if m and int(m.group(1)) > 0 and int(m.group(2)) > 0:
+        return ("spec", (int(m.group(1)), int(m.group(2))))
+    return ("invalid", None)
+
+
+def candidate_shardings(n_dev: int, lanes: int) -> list[tuple[int, int]]:
+    """The (small) candidate space for one bucket shape: every ``(dc,
+    dl)`` with ``dc * dl == n_dev`` and ``dl`` dividing the lane count
+    (inert-lane padding already canonicalized ``lanes``). The default
+    chains-only split is always first."""
+    out = []
+    for dl in range(1, max(1, int(n_dev)) + 1):
+        if n_dev % dl or dl > lanes or lanes % dl:
+            continue
+        out.append((n_dev // dl, dl))
+    return out
+
+
+def note_sharding_evidence(bucket_key: tuple, spec: tuple[int, int], *,
+                           lanes: int, solves: int,
+                           device_s: float) -> None:
+    """File one observation for (bucket, spec): ``solves`` lane-batched
+    dispatches taking ``device_s`` wall seconds at width ``lanes``.
+    Production dispatches and search evaluations both land here — the
+    chooser cannot tell them apart and should not."""
+    if solves <= 0 or device_s <= 0:
+        return
+    with _MESH_LOCK:
+        rows = _SHARD_EVIDENCE.setdefault(tuple(bucket_key), {})
+        row = rows.setdefault(
+            _spec_str(spec),
+            {"solves": 0, "device_s": 0.0, "lanes": int(lanes)},
+        )
+        row["solves"] += int(solves)
+        row["device_s"] += float(device_s)
+        row["lanes"] = int(lanes)
+
+
+def choose_sharding(bucket_key: tuple | None, n_dev: int, lanes: int, *,
+                    multi: bool = False) -> tuple[int, int]:
+    """Resolve the (dc, dl) split for one dispatch site. Precedence:
+    explicit ``KAO_MESH_SHARDING=<dc>x<dl>`` (validated against the
+    bucket shape, default on mismatch), ``off`` → default, else the
+    evidence table — the spec with the best lane-solve throughput among
+    those with ≥ ``MESH_MIN_SOLVES`` observations, default until any
+    challenger qualifies. Multi-controller SPMD always takes the
+    default: evidence tables are per-process and a diverging choice
+    would fork the compiled program across workers (the same hazard
+    ``engine._resolve_megachunk`` guards for megachunk K)."""
+    default = (max(1, int(n_dev)), 1)
+    mode, spec = parse_mesh_sharding()
+    if mode == "off" or mode == "invalid":
+        return default
+    if mode == "spec":
+        dc, dl = spec
+        if dc * dl == n_dev and dl >= 1 and lanes % max(dl, 1) == 0 \
+                and dl <= lanes:
+            return (dc, dl)
+        return default
+    if multi or n_dev <= 1 or lanes <= 1 or bucket_key is None:
+        return default
+    valid = set(candidate_shardings(n_dev, lanes))
+    with _MESH_LOCK:
+        rows = dict(_SHARD_EVIDENCE.get(tuple(bucket_key), {}))
+    best, best_rate = default, -1.0
+    for name, row in rows.items():
+        if row["solves"] < MESH_MIN_SOLVES or row["device_s"] <= 0:
+            continue
+        try:
+            dc, dl = (int(x) for x in name.split("x"))
+        except ValueError:
+            continue
+        if (dc, dl) not in valid:
+            continue
+        rate = row["solves"] * row["lanes"] / row["device_s"]
+        if rate > best_rate or (rate == best_rate and (dc, dl) == default):
+            best, best_rate = (dc, dl), rate
+    if best != default and best_rate > 0:
+        # the default must itself be outscored by real evidence, not
+        # lose by forfeit: without a qualified default row the chooser
+        # stays home (never guesses)
+        d_row = rows.get(_spec_str(default))
+        if d_row is None or d_row["solves"] < MESH_MIN_SOLVES:
+            return default
+        d_rate = d_row["solves"] * d_row["lanes"] / d_row["device_s"]
+        if d_rate >= best_rate:
+            return default
+    return best
+
+
+def make_solve_mesh(n_devices: int | None = None, *,
+                    lanes: int | None = None,
+                    bucket_key: tuple | None = None,
+                    engine: str = "sweep",
+                    multi: bool = False) -> Mesh:
+    """Engine-facing mesh factory for one dispatch site: resolves the
+    per-bucket (chains × lanes) split and builds the mesh. Single-
+    instance sites, the chain engine, and 1-device runs always get the
+    default chains-only split (``auto`` → current behavior on 1
+    device, per the env contract)."""
+    n = len(jax.devices()) if n_devices is None else int(n_devices)
+    if lanes is None or lanes <= 1 or engine != "sweep" or n <= 1:
+        return make_mesh(n_devices)
+    dc, dl = choose_sharding(bucket_key, n, lanes, multi=multi)
+    return make_mesh(n_devices, lane_devices=dl)
+
+
+def note_reshard(state, mesh: Mesh) -> None:
+    """Count bytes of carried state that arrive at a dispatch under a
+    DIFFERENT sharding than the mesh expects — the resharding transfer
+    XLA will insert. Zero on every warm chunk boundary (out_specs hand
+    the next chunk a pre-partitioned state); nonzero means a host
+    gather or a mesh change broke the handoff, surfaced as
+    ``kao_mesh_reshard_bytes_total``."""
+    dc, dl = mesh_spec(mesh)
+    spec = P(AXIS, AXIS_LANES) if dl > 1 else P(AXIS)
+    expected = jax.sharding.NamedSharding(mesh, spec)
+    bad = 0
+    for x in jax.tree_util.tree_leaves(state):
+        sh = getattr(x, "sharding", None)
+        ndim = getattr(x, "ndim", None)
+        if sh is None or ndim is None:
+            continue
+        try:
+            if not sh.is_equivalent_to(expected, ndim):
+                bad += int(x.size) * int(x.dtype.itemsize)
+        except Exception:
+            continue
+    if bad:
+        with _MESH_LOCK:
+            _MESH_COUNTERS["reshard_bytes"] += bad
+
+
+def mesh_counters() -> dict:
+    with _MESH_LOCK:
+        return dict(_MESH_COUNTERS)
+
+
+def mesh_snapshot() -> dict:
+    """/healthz evidence: last-built axis sizes, the env override mode,
+    running counters, and the per-bucket evidence table with each
+    bucket's current choice."""
+    mode, spec = parse_mesh_sharding()
+    with _MESH_LOCK:
+        axes = dict(_MESH_STATE.get("axes") or {})
+        counters = dict(_MESH_COUNTERS)
+        table = {
+            k: {s: dict(row) for s, row in rows.items()}
+            for k, rows in _SHARD_EVIDENCE.items()
+        }
+    n_dev = axes.get(AXIS, 1) * axes.get(AXIS_LANES, 1)
+    buckets = {}
+    for k, rows in sorted(table.items()):
+        lanes = max((row["lanes"] for row in rows.values()), default=1)
+        buckets["x".join(str(x) for x in k)] = {
+            "chosen": _spec_str(
+                choose_sharding(k, n_dev, lanes)
+            ),
+            "evidence": rows,
+        }
+    return {
+        "axes": axes,
+        "sharding_mode": mode,
+        "sharding_env": os.environ.get(MESH_ENV, ""),
+        "forced_spec": _spec_str(spec) if spec else None,
+        "min_solves": MESH_MIN_SOLVES,
+        "counters": counters,
+        "buckets": buckets,
+    }
+
+
+def reset_mesh_adapt() -> None:
+    """Drop sharding evidence and counters (tests + maintenance)."""
+    with _MESH_LOCK:
+        _SHARD_EVIDENCE.clear()
+        for k in _MESH_COUNTERS:
+            _MESH_COUNTERS[k] = 0
+
+
+def run_sharding_search(
+    m_stack,
+    lane_seeds,
+    keys,
+    temps,
+    *,
+    n_devices: int,
+    chains_per_device: int,
+    bucket_key: tuple,
+    scorer: str = "xla",
+    repeats: int = 1,
+    check_parity: bool = True,
+):
+    """Automap-style active search: time every candidate (dc × dl)
+    split of this bucket through the REAL ``solve_lanes`` dispatch path
+    (AOT executable cache, profiler funnel, donation — nothing
+    synthetic), file the observations in the evidence table, and return
+    the per-candidate results. The first dispatch per candidate warms
+    the executable and is excluded from timing; each timed repeat
+    re-inits state (the solver donates it). With ``check_parity`` the
+    global winners of every split are compared bit-for-bit against the
+    default split — the parity contract as a runtime assert.
+
+    Drive this from bench ``--mesh-bench``, the soak mesh step, or
+    warmup; production solves only ever *read* the table."""
+    lane_seeds = np.asarray(lane_seeds, np.int32)
+    lanes = int(lane_seeds.shape[0])
+    results = []
+    base_k = None
+    for dc, dl in candidate_shardings(n_devices, lanes):
+        mesh = make_mesh(n_devices, lane_devices=dl)
+        device_s = 0.0
+        warm_s = 0.0
+        n_timed = 0
+        for r in range(int(repeats) + 1):
+            state = init_lane_state(
+                m_stack, lane_seeds, keys, mesh, chains_per_device
+            )
+            t0 = time.perf_counter()
+            _st, _ba, best_k, _curve = solve_lanes(
+                m_stack, mesh, chains_per_device, temps, state=state,
+                scorer=scorer,
+            )
+            jax.block_until_ready(best_k)
+            dt = time.perf_counter() - t0
+            if r > 0:
+                device_s += dt
+                warm_s = dt if n_timed == 0 else min(warm_s, dt)
+                n_timed += 1
+        best_k_host = np.asarray(fetch_global(best_k))
+        parity = None
+        if check_parity:
+            if base_k is None:
+                base_k, parity = best_k_host, True
+            else:
+                parity = bool(np.array_equal(base_k, best_k_host))
+        note_sharding_evidence(
+            bucket_key, (dc, dl), lanes=lanes, solves=max(n_timed, 1),
+            device_s=device_s,
+        )
+        with _MESH_LOCK:
+            _MESH_COUNTERS["search_evals"] += 1
+        results.append({
+            "spec": _spec_str((dc, dl)),
+            "warm_s": warm_s,
+            "lanes_per_s": (lanes / warm_s) if warm_s > 0 else 0.0,
+            "parity_vs_default": parity,
+        })
+    return results
 
 
 # compiled sharded solvers, keyed by (device ids, search params); the
@@ -345,6 +687,12 @@ def _compiled_solver(
     engine: str = "chain",
     scorer: str = "xla",
 ):
+    _dc, dl = mesh_spec(mesh)
+    if dl > 1:
+        raise ValueError(
+            "single-instance solvers shard chains only — build the "
+            "mesh with lane_devices=1 (make_solve_mesh does)"
+        )
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
         chains_per_device, steps_per_round, engine, scorer,
@@ -443,20 +791,52 @@ def _compiled_lane_solver(
     chains-over-devices sharding as ``_compiled_solver``, with the lane
     axis vmapped INSIDE each shard — so global state leaves are
     ``[n_dev, L, ...]`` sharded on the device axis, and the per-lane
-    migration collectives ride the same mesh axis. Cached alongside the
-    single-instance solvers (the "lanes" tag keeps the keys disjoint);
-    jit's shape keying handles L, so warm same-bucket batches of a new
-    size compile once and then dispatch the cached executable."""
+    migration collectives ride the same mesh axis. When the mesh
+    carries a lane split (``dl > 1``, docs/MESH.md) the lane axis is
+    ADDITIONALLY sharded over devices: the chain axis keeps its full
+    ``n_dev`` logical shards — each device vmaps a block of ``dl`` of
+    them under the ``'cblk'`` axis name — and the migration collectives
+    run over ``('chains', 'cblk')``, spanning exactly the logical
+    shards of the unsplit layout, so the trajectory is bit-identical
+    and the global output shapes are unchanged. Cached alongside the
+    single-instance solvers (the "lanes" / "lanes@<dc>x<dl>" tag keeps
+    the keys disjoint); jit's shape keying handles L, so warm
+    same-bucket batches of a new size compile once and then dispatch
+    the cached executable."""
+    dc, dl = mesh_spec(mesh)
+    if dl > 1 and engine != "sweep":
+        raise ValueError("lane-axis sharding is sweep-engine only")
+    tag = "lanes" if dl == 1 else f"lanes@{dc}x{dl}"
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
-        chains_per_device, steps_per_round, engine, scorer, "lanes",
+        chains_per_device, steps_per_round, engine, scorer, tag,
     )
     with _COMPILED_LOCK:
         fn = _COMPILED.get(cache_key)
         if fn is not None:
             _COMPILED[cache_key] = _COMPILED.pop(cache_key)
     if fn is None:
-        if engine == "sweep":
+        if engine == "sweep" and dl > 1:
+            from ..solvers.tpu.sweep import make_lane_stepper_fn
+
+            # local block: state [dl, L/dl, ...], m_stack [L/dl, ...].
+            # lax.axis_index(('chains', 'cblk')) inside the stepper is
+            # chains_idx * dl + cblk_idx — the row-major identity with
+            # the unsplit 1-D layout — so migration elects the same
+            # owner chain and clones the same rows, bit-for-bit.
+            lane_solve = make_lane_stepper_fn(
+                chains_per_device, axis_name=(AXIS, _CBLK), scorer=scorer
+            )
+            solve = jax.vmap(
+                lane_solve, in_axes=(None, 0, None), axis_name=_CBLK
+            )
+
+            def shard_fn(m_stack, state, temps: jax.Array):
+                return solve(m_stack, state, temps)
+
+            in_specs = (P(AXIS_LANES), P(AXIS, AXIS_LANES), P())
+            out_specs = (P(AXIS, AXIS_LANES),) * 4
+        elif engine == "sweep":
             from ..solvers.tpu.sweep import make_lane_stepper_fn
 
             solve = make_lane_stepper_fn(
@@ -527,10 +907,17 @@ def _compiled_mega_solver(
     scan carry's leaves alias the input buffers leaf-for-leaf."""
     if engine != "sweep":
         raise ValueError("megachunk fusion is sweep-engine only")
+    dc, dl = mesh_spec(mesh)
+    if dl > 1 and not lanes:
+        raise ValueError(
+            "lane-axis sharding needs the lane-batched stepper — "
+            "single-instance megachunks use a lane_devices=1 mesh"
+        )
+    base_tag = "mega-lanes" if lanes else "mega"
+    tag = base_tag if dl == 1 else f"{base_tag}@{dc}x{dl}"
     cache_key = (
         tuple(d.id for d in mesh.devices.flat),
-        chains_per_device, steps_per_round, engine, scorer,
-        "mega-lanes" if lanes else "mega",
+        chains_per_device, steps_per_round, engine, scorer, tag,
     )
     with _COMPILED_LOCK:
         fn = _COMPILED.get(cache_key)
@@ -542,20 +929,46 @@ def _compiled_mega_solver(
             make_mega_stepper_fn,
         )
 
-        build = make_mega_lane_stepper_fn if lanes else make_mega_stepper_fn
-        solve = build(chains_per_device, axis_name=AXIS, scorer=scorer)
+        if dl > 1:
+            # same chain-block construction as _compiled_lane_solver;
+            # the fused stepper's early-exit pmax additionally spans
+            # ('laneblk', 'lanes') — the in-shard lane vmap plus its
+            # device-sharded complement — so a certificate anywhere
+            # still stops every lane (first-to-certify, PR 11).
+            solve_l = make_mega_lane_stepper_fn(
+                chains_per_device, axis_name=(AXIS, _CBLK),
+                scorer=scorer, mesh_lane_axis=AXIS_LANES,
+            )
+            solve = jax.vmap(
+                solve_l, in_axes=(None, 0, None, None, None, None),
+                axis_name=_CBLK,
+            )
 
-        def shard_fn(m_arg, state, temps, active, cert_k, cert_mv):
-            state = jax.tree.map(lambda x: x[0], state)
-            (state, top_a, top_k, cert_a, cert_ok, cert_mvs, curves,
-             execd) = solve(m_arg, state, temps, active, cert_k, cert_mv)
-            state = jax.tree.map(lambda x: x[None], state)
-            return (state, top_a[None], top_k[None], cert_a[None],
-                    cert_ok[None], cert_mvs[None], curves[None],
-                    execd[None])
+            def shard_fn(m_arg, state, temps, active, cert_k, cert_mv):
+                return solve(m_arg, state, temps, active, cert_k,
+                             cert_mv)
 
-        in_specs = (P(), P(AXIS), P(), P(), P(), P())
-        out_specs = (P(AXIS),) * 8
+            in_specs = (P(AXIS_LANES), P(AXIS, AXIS_LANES), P(), P(),
+                        P(), P())
+            out_specs = (P(AXIS, AXIS_LANES),) * 8
+        else:
+            build = (make_mega_lane_stepper_fn if lanes
+                     else make_mega_stepper_fn)
+            solve = build(chains_per_device, axis_name=AXIS,
+                          scorer=scorer)
+
+            def shard_fn(m_arg, state, temps, active, cert_k, cert_mv):
+                state = jax.tree.map(lambda x: x[0], state)
+                (state, top_a, top_k, cert_a, cert_ok, cert_mvs, curves,
+                 execd) = solve(m_arg, state, temps, active, cert_k,
+                                cert_mv)
+                state = jax.tree.map(lambda x: x[None], state)
+                return (state, top_a[None], top_k[None], cert_a[None],
+                        cert_ok[None], cert_mvs[None], curves[None],
+                        execd[None])
+
+            in_specs = (P(), P(AXIS), P(), P(), P(), P())
+            out_specs = (P(AXIS),) * 8
         fn = jax.jit(
             _shard_map(
                 shard_fn,
@@ -644,6 +1057,7 @@ def solve_lanes_megachunk(
         mesh, chains_per_device, steps_per_round, "sweep", scorer,
         lanes=True,
     )
+    note_reshard(state, mesh)
     return _dispatch(fn, solver_key, _mega_args(
         m_stack, state, temps_stack, active, cert_k, cert_mv
     ))
@@ -665,11 +1079,23 @@ def init_lane_state(
     ``keys[l]`` (the B=1 bit-parity anchor).
 
     ``lane_seeds`` is host numpy ``[L, P, R]`` (padded to the bucket);
-    ``keys`` is ``[L, 2]`` per-lane PRNG keys."""
+    ``keys`` is ``[L, 2]`` per-lane PRNG keys.
+
+    The GLOBAL layout is spec-invariant: leaves are always ``[n_dev, L,
+    ...]`` with the chain axis carrying ``n_dev`` logical shards; a
+    lane-split mesh (``dl > 1``) merely places them ``P('chains',
+    'lanes')`` instead of ``P('chains')`` — same bytes, different
+    device assignment — which is what makes every sharding of a bucket
+    replay the same trajectory (docs/MESH.md)."""
     n_dev = mesh.devices.size
     n = chains_per_device
     lane_seeds = np.asarray(lane_seeds, np.int32)
     L, n_parts, n_slots = lane_seeds.shape
+    _dc, dl = mesh_spec(mesh)
+    if L % max(dl, 1):
+        raise ValueError(
+            f"lane count {L} not divisible by lane axis size {dl}"
+        )
     k0, mv0 = _lane_seed_rank_fn()(jnp.asarray(lane_seeds), m_stack)
     k0, mv0 = np.asarray(k0), np.asarray(mv0)  # [L]
     tile = np.broadcast_to(
@@ -688,7 +1114,9 @@ def init_lane_state(
         np.array(tile),
         jnp.transpose(dev_keys, (1, 0, 2)),
     )
-    sh = jax.sharding.NamedSharding(mesh, P(AXIS))
+    sh = jax.sharding.NamedSharding(
+        mesh, P(AXIS, AXIS_LANES) if dl > 1 else P(AXIS)
+    )
     return jax.tree.map(lambda x: jax.device_put(x, sh), state)
 
 
@@ -752,6 +1180,8 @@ def solve_lanes(
             state = init_lane_state(
                 m_stack, lane_seeds, keys, mesh, chains_per_device
             )
+        else:
+            note_reshard(state, mesh)
         return _dispatch(fn, solver_key, (m_stack, state, temps))
     n_dev = mesh.devices.size
     dev_keys = jnp.transpose(

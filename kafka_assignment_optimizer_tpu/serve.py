@@ -1004,6 +1004,46 @@ def render_metrics() -> str:
         f"kao_decompose_last_subproblems "
         f'{int(dsnap["last"].get("subproblems") or 0)}'
     )
+    # sharded solve mesh (docs/MESH.md): axis sizes of the last built
+    # mesh, the counter families pre-declared at zero (the rollout
+    # discipline), and one row per bucket the sharding chooser has
+    # evidence for — the choice a new dispatch of that bucket gets
+    from .parallel.mesh import mesh_snapshot as _mesh_snapshot
+
+    msnap = _mesh_snapshot()
+    lines.append("# HELP kao_mesh_axis_size solve-mesh axis sizes "
+                 "(chains x lanes device split, docs/MESH.md)")
+    lines.append("# TYPE kao_mesh_axis_size gauge")
+    for ax in sorted(msnap["axes"]):
+        lines.append(
+            f'kao_mesh_axis_size{{axis="{ax}"}} {msnap["axes"][ax]}'
+        )
+    lines.append("# HELP kao_mesh_sharding_search_evals_total sharding "
+                 "candidates timed by run_sharding_search")
+    lines.append("# TYPE kao_mesh_sharding_search_evals_total counter")
+    lines.append(
+        "kao_mesh_sharding_search_evals_total "
+        f'{msnap["counters"]["search_evals"]}'
+    )
+    lines.append("# HELP kao_mesh_reshard_bytes_total carried-state "
+                 "bytes that arrived at a dispatch under the wrong "
+                 "sharding (resharding transfer)")
+    lines.append("# TYPE kao_mesh_reshard_bytes_total counter")
+    lines.append(
+        "kao_mesh_reshard_bytes_total "
+        f'{msnap["counters"]["reshard_bytes"]}'
+    )
+    lines.append("# HELP kao_mesh_bucket_sharding per-bucket chosen "
+                 "(chains x lanes) split; value is evidence solve "
+                 "count behind the choice")
+    lines.append("# TYPE kao_mesh_bucket_sharding gauge")
+    for bkt in sorted(msnap["buckets"]):
+        row = msnap["buckets"][bkt]
+        ev = row["evidence"].get(row["chosen"], {})
+        lines.append(
+            f'kao_mesh_bucket_sharding{{bucket="{bkt}",'
+            f'spec="{row["chosen"]}"}} {int(ev.get("solves", 0))}'
+        )
     # load sheds by reason: every 503 names why it shed, and the full
     # reason set is pre-declared at zero so dashboards can alert on
     # rate() without waiting for the first shed
@@ -2234,6 +2274,11 @@ def handle_healthz() -> dict:
         # mode, sub-bucket ladder, counters, and whether the last
         # sub-bucket's map-lane executable is warm in-process
         "decompose": _healthz_decompose(),
+        # sharded solve mesh (docs/MESH.md): axis sizes of the last
+        # built mesh, the KAO_MESH_SHARDING mode, per-bucket sharding
+        # evidence with each bucket's current choice, the reshard /
+        # search counters, and the multi-process probe's cached verdict
+        "mesh": _healthz_mesh(),
         "observability": {
             "trace_enabled": bool(OBS["trace"]),
             "solve_reports_held": len(_otrace.RECENT.ids()),
@@ -2312,6 +2357,32 @@ def _healthz_megachunk() -> dict:
     from .solvers.tpu.engine import megachunk_snapshot
 
     return megachunk_snapshot()
+
+
+def _healthz_mesh() -> dict:
+    """The /healthz mesh section (docs/MESH.md): the named-mesh axis
+    sizes, env override mode, per-bucket sharding evidence + current
+    choice, and the running search/reshard counters — one snapshot
+    shared with the kao_mesh_* metric families so the views agree. The
+    multi-process probe's MEMOIZED verdict rides along (never probed
+    here: /healthz must stay cheap), so a fleet dashboard can see why
+    multi-controller wiring is or is not armed."""
+    import jax
+
+    from .parallel import distributed as _dist
+    from .parallel.mesh import mesh_snapshot
+
+    snap = mesh_snapshot()
+    probe = _dist._PROBE_MEMO
+    snap["processes"] = {
+        "n_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "multiprocess_probe": (
+            {"probed": True, "ok": probe[0], "reason": probe[1]}
+            if probe is not None else {"probed": False}
+        ),
+    }
+    return snap
 
 
 def _healthz_decompose() -> dict:

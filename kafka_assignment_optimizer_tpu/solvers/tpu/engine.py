@@ -2574,6 +2574,18 @@ def _solve_tpu_inner(
 
         note_lane_serve((inst.num_brokers, inst.num_racks,
                          int(bkt_parts), int(bkt_rf)), pw, port_lanes)
+        # the portfolio dispatch rides the shared solve mesh: rebuild
+        # with the per-bucket (chains × lanes) split the chooser picked
+        # (docs/MESH.md; default chains-only until evidence, same
+        # devices either way so n_dev/chains_per_device are unchanged)
+        from ...parallel.mesh import make_solve_mesh
+
+        mesh = make_solve_mesh(
+            n_devices, lanes=port_lanes,
+            bucket_key=(inst.num_brokers, inst.num_racks,
+                        int(bkt_parts), int(bkt_rf)),
+            engine=engine, multi=multi,
+        )
         sweep_state = init_lane_state(
             m_solver, lane_seeds, lane_keys, mesh, chains_per_device
         )
@@ -2626,9 +2638,16 @@ def _solve_tpu_inner(
         # key space as the multi-tenant batch path — they dispatch the
         # identical lane-padded executable, so they share its estimate.
         if port_lanes:
+            from ...parallel.mesh import mesh_spec
+
             warm_key = ("lanes", port_lanes, engine, n_dev,
                         chains_per_device, steps_per_round,
                         int(bkt_parts), int(bkt_rf))
+            # lane-split estimates file under their own identity; the
+            # default split keeps the historical key byte-for-byte
+            _pdc, _pdl = mesh_spec(mesh)
+            if _pdl > 1:
+                warm_key = (*warm_key, f"{_pdc}x{_pdl}")
         else:
             warm_key = ("single", engine, n_dev, chains_per_device,
                         steps_per_round, int(bkt_parts), int(bkt_rf))
@@ -2697,6 +2716,17 @@ def _solve_tpu_inner(
                 dispatches=ev_n, dispatch_s=ev_disp,
                 chunks=lad.chunks_exec, device_s=ev_dev,
             )
+            if port_lanes:
+                # sharding evidence rides the same funnel (docs/MESH.md)
+                from ...parallel.mesh import (
+                    mesh_spec, note_sharding_evidence,
+                )
+
+                note_sharding_evidence(
+                    (inst.num_brokers, inst.num_racks, int(bkt_parts),
+                     int(bkt_rf)), mesh_spec(mesh),
+                    lanes=port_lanes, solves=ev_n, device_s=ev_dev,
+                )
     else:
         # constructed fast path: the ladder never runs, and calling into
         # it would import device-adjacent modules this path avoids
@@ -3253,7 +3283,16 @@ def _solve_batch_body(
         seed_moves = [int(inst.move_count(arrays.unpad_candidate(
             lane_seeds[i], inst))) for i, inst in enumerate(insts)]
 
-    mesh = make_mesh(n_devices)
+    # the lane dispatches below ride the shared solve mesh: the
+    # (chains × lanes) split is the per-bucket chooser's call
+    # (docs/MESH.md) — default chains-only until evidence says a lane
+    # split wins this bucket; trajectories are split-invariant
+    from ...parallel.mesh import make_solve_mesh, mesh_spec
+
+    mesh = make_solve_mesh(
+        n_devices, lanes=Lp, bucket_key=(B, K, bkt_parts, bkt_rf),
+        engine=engine,
+    )
     n_dev = mesh.devices.size
     chains_per_device = max(1, batch // n_dev)
     # padded lanes get derived keys so no two lanes ever consume one
@@ -3299,6 +3338,12 @@ def _solve_batch_body(
     chunk_len = int(chunks[0].shape[0]) if n else 0
     warm_key = ("lanes", Lp, engine, n_dev, chains_per_device,
                 steps_per_round, int(bkt_parts), int(bkt_rf))
+    # a lane-split mesh changes the per-dispatch cost profile, so its
+    # estimates and fusion evidence file under their own identity; the
+    # default split keeps the historical key byte-for-byte
+    _mesh_dc, _mesh_dl = mesh_spec(mesh)
+    if _mesh_dl > 1:
+        warm_key = (*warm_key, f"{_mesh_dc}x{_mesh_dl}")
 
     def _wkey(width: int = 1):
         # width-keyed like the single path's registry: fused and
@@ -3699,6 +3744,14 @@ def _solve_batch_body(
             (*warm_key, chunk_len, scorer),
             dispatches=ev_n, dispatch_s=ev_disp,
             chunks=chunks_exec, device_s=ev_dev,
+        )
+        # sharding evidence rides the same funnel: production batches
+        # keep the table honest about the split they actually ran
+        from ...parallel.mesh import note_sharding_evidence
+
+        note_sharding_evidence(
+            (B, K, bkt_parts, bkt_rf), (_mesh_dc, _mesh_dl),
+            lanes=Lp, solves=ev_n, device_s=ev_dev,
         )
     t_solve = time.perf_counter()
 

@@ -222,21 +222,42 @@ def best_key(w: jax.Array, pen: jax.Array) -> jax.Array:
     return jnp.where(pen == 0, w, -pen - 1)
 
 
-def _make_to_varying(axis_name: str):
+def _make_to_varying(axis_name):
     """Cast replicated leaves to device-varying inside ``shard_map`` —
     required by jax's varying-manual-axes (vma) system. Pre-vma jax
     (0.4.x) has neither ``jax.typeof`` nor ``lax.pcast`` and needs no
     cast (``check_rep=False`` at the shard_map boundary), so the shim
-    degrades to identity there."""
+    degrades to identity there.
+
+    ``axis_name`` may be a tuple (docs/MESH.md): the sharded lane paths
+    run collectives over ``(mesh_axis, vmap_axis)`` so migration spans
+    every logical chain shard regardless of the (chains × lanes) device
+    split. Only MESH axes carry vma state — a vmap-introduced axis has
+    nothing to pcast over — so tuple members are cast individually and
+    names absent from the abstract mesh are skipped."""
     typeof = getattr(jax, "typeof", None)
     pcast = getattr(lax, "pcast", None)
     if typeof is None or pcast is None:
         return lambda x: x
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+    def _mesh_axes():
+        get = getattr(jax.sharding, "get_abstract_mesh", None)
+        try:
+            return set(get().axis_names) if get is not None else None
+        except Exception:
+            return None
 
     def to_varying(x):
-        if axis_name in getattr(typeof(x), "vma", frozenset()):
-            return x
-        return pcast(x, axis_name, to="varying")
+        vma = getattr(typeof(x), "vma", frozenset())
+        mesh_axes = _mesh_axes()
+        for n in names:
+            if n in vma:
+                continue
+            if mesh_axes is not None and n not in mesh_axes:
+                continue  # vmap axis: no vma to cast over
+            x = pcast(x, n, to="varying")
+        return x
 
     return to_varying
 
@@ -1427,19 +1448,26 @@ def make_mega_lane_stepper_fn(
     snapshot_every: int = 8,
     axis_name: str | None = None,
     scorer: str = "xla",
+    mesh_lane_axis: str | None = None,
 ):
     """Lane-batched :func:`make_mega_stepper_fn` — ``jax.vmap`` over
     the lane axis exactly as :func:`make_lane_stepper_fn` wraps the
     chunk stepper, so a lane's fused trajectory is bit-identical to
-    solving it alone. The vmap carries ``axis_name=\"lanes\"`` so the
+    solving it alone. The vmap carries ``axis_name=\"laneblk\"`` so the
     early-exit ``pmax`` also spans lanes: in portfolio mode ANY lane
-    certifying stops every lane (first-to-certify, PR 11). Under vmap
-    the per-step ``lax.cond`` lowers to a select (both branches
-    execute), so lanes save dispatches and host round-trips but not
-    per-lane device compute after an exit — documented in
-    docs/PIPELINE.md. Batch-mode callers always pass the disarmed
-    sentinels (independent instances must not share an exit)."""
+    certifying stops every lane (first-to-certify, PR 11). When the
+    lane axis is additionally split over mesh devices (docs/MESH.md),
+    ``mesh_lane_axis`` names that mesh axis and the exit pmax spans
+    ``(\"laneblk\", mesh_lane_axis)`` — the vmap block plus its sharded
+    complement, i.e. every lane, exactly as before. Under vmap the
+    per-step ``lax.cond`` lowers to a select (both branches execute),
+    so lanes save dispatches and host round-trips but not per-lane
+    device compute after an exit — documented in docs/PIPELINE.md.
+    Batch-mode callers always pass the disarmed sentinels (independent
+    instances must not share an exit)."""
+    lane_axis = ("laneblk" if mesh_lane_axis is None
+                 else ("laneblk", mesh_lane_axis))
     solve = make_mega_stepper_fn(n_chains, snapshot_every, axis_name,
-                                 scorer, lane_axis="lanes")
+                                 scorer, lane_axis=lane_axis)
     return jax.vmap(solve, in_axes=(0, 0, None, None, None, None),
-                    axis_name="lanes")
+                    axis_name="laneblk")

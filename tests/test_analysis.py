@@ -653,6 +653,121 @@ def test_kao114_time_delta_outside_funnel():
     )
 
 
+# ---------------------------------------------------------------- KAO115
+
+POS_115_SHARDMAP = """
+    def host(fn, mesh):
+        return _shard_map(fn, mesh=mesh)  # placements left implicit
+"""
+
+POS_115_PJIT = """
+    from jax.experimental.pjit import pjit
+
+    def host(fn):
+        return pjit(fn, donate_argnums=(1,))
+"""
+
+POS_115_MODULE_SNAPSHOT = """
+    import jax
+
+    DEVS = jax.devices()  # frozen at import
+"""
+
+POS_115_DEFAULT_ARG = """
+    import jax
+
+    def make_solver(devs=jax.devices()):
+        return len(devs)
+"""
+
+POS_115_FACTORY_CAPTURE = """
+    import jax
+
+    def make_dispatch():
+        devs = jax.devices()
+
+        def dispatch(state):
+            return shard(state, devs)  # closure pins the snapshot
+
+        return dispatch
+"""
+
+NEG_115_EXPLICIT = """
+    def host(fn, mesh, in_specs, out_specs):
+        sharded = _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+        jitted = pjit(fn, in_shardings=in_specs, out_shardings=out_specs)
+        return sharded, jitted
+"""
+
+NEG_115_LOCAL_USE = """
+    import jax
+
+    def make_mesh(n_devices=None):
+        devs = jax.devices()  # resolved per call, used in this body
+        return Mesh(devs[:n_devices], ("chains",))
+"""
+
+NEG_115_SHADOWED = """
+    import jax
+
+    def make_dispatch():
+        devs = jax.devices()
+        mesh = Mesh(devs, ("chains",))
+
+        def dispatch(state, devs):
+            return shard(state, devs)  # parameter, not the snapshot
+
+        return dispatch, mesh
+"""
+
+
+def test_kao115_implicit_placement_sites():
+    # implicit-placement dispatch sites, scoped to parallel/
+    assert "KAO115" in _rules(
+        _lint(POS_115_SHARDMAP, rel="parallel/mesh.py")
+    )
+    assert "KAO115" in _rules(_lint(POS_115_PJIT, rel="parallel/mesh.py"))
+    # out of scope: other modules own their own dispatch idioms
+    assert "KAO115" not in _rules(_lint(POS_115_SHARDMAP))
+    # explicit specs on every site is the sanctioned shape
+    assert "KAO115" not in _rules(
+        _lint(NEG_115_EXPLICIT, rel="parallel/mesh.py")
+    )
+
+
+def test_kao115_stale_device_snapshots():
+    # stale device snapshots: module scope, default arg, factory closure
+    assert "KAO115" in _rules(
+        _lint(POS_115_MODULE_SNAPSHOT, rel="parallel/mesh.py")
+    )
+    assert "KAO115" in _rules(
+        _lint(POS_115_DEFAULT_ARG, rel="parallel/mesh.py")
+    )
+    assert "KAO115" in _rules(
+        _lint(POS_115_FACTORY_CAPTURE, rel="parallel/mesh.py")
+    )
+    # a device list resolved and consumed inside one body is fine (the
+    # make_mesh shape), as is a nested def shadowing the name
+    assert "KAO115" not in _rules(
+        _lint(NEG_115_LOCAL_USE, rel="parallel/mesh.py")
+    )
+    assert "KAO115" not in _rules(
+        _lint(NEG_115_SHADOWED, rel="parallel/mesh.py")
+    )
+
+
+def test_kao115_suppressible_with_justification():
+    # suppressible with justification, like every rule
+    sup = POS_115_SHARDMAP.replace(
+        "return _shard_map(fn, mesh=mesh)  # placements left implicit",
+        "return _shard_map(fn, mesh=mesh)  "
+        "# kao: disable=KAO115 -- fixture: replicated-only helper",
+    )
+    assert "KAO115" not in _rules(_lint(sup, rel="parallel/mesh.py"))
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_requires_justification():

@@ -134,3 +134,33 @@ def test_seed_time_budget_at_headline_scale():
     # accidental O(P*B) Python loop would take minutes, so 15 s catches
     # the regression class without flaking on contended CI runners
     assert seed_s < 15.0, f"greedy_seed took {seed_s:.2f}s at headline scale"
+
+
+def test_compact_mesh_block_shapes():
+    """The --mesh-bench stdout block (ISSUE 19): the compactor carries
+    the comparator-gated keys + the spec->lanes/s curve, and the error
+    path still lands a parsable block."""
+    import bench
+
+    rm = {
+        "n_devices": 8, "lanes": 4, "bucket": [32, 8, 90, 3],
+        "parity_ok": True, "chosen": "8x1",
+        "default_lanes_per_s": 4.0, "best_spec": "4x2",
+        "best_lanes_per_s": 5.0, "lane_scaling": 1.25,
+        "search_s": 9.0, "search_evals": 3,
+        "single_core_parity_expected": True,
+        "specs": [
+            {"spec": "8x1", "lanes_per_s": 4.0, "warm_s": 1.0,
+             "parity_vs_default": True},
+            {"spec": "4x2", "lanes_per_s": 5.0, "warm_s": 0.8,
+             "parity_vs_default": True},
+        ],
+    }
+    out = bench._compact_mesh(rm, None)
+    assert out["parity_ok"] is True
+    assert out["curve"] == {"8x1": 4.0, "4x2": 5.0}
+    assert out["best_spec"] == "4x2"
+    assert out["single_core_parity_expected"] is True
+    # a dead child still prints a parsable, bounded error block
+    err = bench._compact_mesh(None, "boom " * 100)
+    assert "error" in err and len(err["error"]) <= 120

@@ -39,6 +39,10 @@ def _tiny_instance(rng, n_parts, n_brokers=8, rf=2, n_racks=2):
     )
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~22 s; nightly. Tier-1 keeps warm-reuse pins at
+# the decompose (test_second_decomposed_solve_compiles_nothing) and
+# sharded-mesh (test_sharded_warm_resolve_compiles_nothing) layers.
 def test_ladder_walk_no_duplicate_compiles(rng, monkeypatch):
     """For each of the first rungs: two instances with different
     partition counts in the bucket run the sweep solver; the second

@@ -170,6 +170,9 @@ def _adversarial_profile_guard(sc):
     return inst
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~24 s; nightly. Tier-1 keeps the padded-row and
+# ladder-dedup bucketing pins; quality identity re-proves nightly.
 def test_bucketed_solve_quality_identical_to_unbucketed(monkeypatch):
     """Layer 2: the bucketed sweep solve of a constructor-proof
     instance certifies the same optimum as the unbucketed solve —

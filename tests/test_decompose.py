@@ -223,6 +223,10 @@ def test_should_decompose_kwarg_env_auto(monkeypatch):
     assert should_decompose(inst, None) is True
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~17 s; nightly. Tier-1 keeps the decompose gate
+# pins (should_decompose env/auto) and the warm-reuse pin
+# (test_second_decomposed_solve_compiles_nothing).
 def test_warm_start_and_precompile_skip_decompose(smoke_inst,
                                                  monkeypatch):
     # even force-on, the engine's gate keeps adapted-plan warm starts

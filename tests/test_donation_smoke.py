@@ -176,6 +176,10 @@ def test_chain_engine_args_not_donated(rng):
     jax.block_until_ready(ba2)
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~12 s; nightly. Tier-1 keeps the direct donation
+# pins (lane state donated, sweep-state reuse raises) that fail first
+# if donation breaks.
 def test_engine_end_to_end_through_donated_path(rng):
     """A chunked sweep solve through the full engine (4 chunks threading
     donated state, pipelined dispatch on) stays feasible and verified —

@@ -87,6 +87,10 @@ def test_chain_lane_b1_bit_parity():
     assert np.array_equal(np.asarray(cv1), np.asarray(cv2)[:, 0])
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~20 s; nightly. Tier-1 keeps the same interpret-
+# under-lane-vmap path via test_sharded_interpret_scorer_bit_parity
+# (whose dl=1 base IS this dispatch).
 def test_lane_vmap_interpret_scorer_parity():
     """The Pallas kernels under the lane vmap (interpret mode on CPU —
     the very code path the TPU runs) match the XLA scorer bit-for-bit."""
@@ -107,6 +111,10 @@ def test_lane_vmap_interpret_scorer_parity():
     assert np.array_equal(np.asarray(o_x[2]), np.asarray(o_p[2]))
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~18 s; nightly. Tier-1 keeps lane-vs-b1 parity at
+# the mesh level (sweep + chain b1 pins) and the engine batch dispatch
+# via test_engine_batch_parity_under_forced_split.
 def test_solve_tpu_batch_matches_b1_lane_solves():
     """Engine-level contract: every lane of a B=3 batch returns exactly
     the plan its own B=1 lane solve returns (same bucket, same seeds)

@@ -98,6 +98,10 @@ def test_megachunk_bit_identical_to_chunked(rng):
     assert r1.solve.stats["dispatches"] == n_chunks
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~30 s; nightly. Tier-1 keeps fused-vs-chunked
+# parity at the optimize level (test_megachunk_bit_identical_to_chunked)
+# and sharded megachunk parity (test_mesh_sharding.py).
 def test_megachunk_mesh_parity_xla_and_interpret(rng):
     """Mesh-level: one fused solve_megachunk dispatch over K=4 chunk
     steps replays the 4-dispatch chunked loop bit-for-bit — final

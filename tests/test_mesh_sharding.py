@@ -1,0 +1,314 @@
+"""Sharded solve mesh: per-bucket (chains × lanes) splits (ISSUE 19).
+
+Pins the docs/MESH.md contracts:
+
+- **bit-parity replay**: any ``(dc, dl)`` split of the same bucket
+  reproduces the default chains-only trajectory BIT FOR BIT — the
+  logical chain-shard count is always the device count, a lane split
+  only re-tiles which physical device hosts which (shard, lane) block,
+  and the in-shard ``cblk`` vmap axis composes with the mesh chain axis
+  so every collective sees the identical participant set in the
+  identical order. Pinned for the sync chunked path, the fused
+  megachunk path, the Pallas-interpret scorer (the code path TPU
+  compiles via Mosaic), and the engine-level batch dispatch under
+  ``KAO_MESH_SHARDING``.
+- **spec-invariant global layout**: ``init_lane_state`` and the solve
+  outputs keep the same global ``[C, L, ...]`` shapes under every
+  split, so callers never see the sharding.
+- **never-guess chooser**: explicit env spec > ``off`` > evidence; the
+  default split wins until a challenger AND the default both carry
+  ``MESH_MIN_SOLVES`` observations and the challenger wins on
+  throughput; multi-controller always takes the default (per-process
+  evidence must not fork the SPMD executable).
+- **warm cache**: each split is its own AOT executable
+  (``lanes@{dc}x{dl}`` tag); a warm re-solve at the same split
+  compiles nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assignment_optimizer_tpu import build_instance
+from kafka_assignment_optimizer_tpu.parallel import mesh as pm
+from kafka_assignment_optimizer_tpu.solvers.tpu import arrays
+from kafka_assignment_optimizer_tpu.solvers.tpu.engine import solve_tpu_batch
+from kafka_assignment_optimizer_tpu.solvers.tpu.seed import greedy_seed
+from kafka_assignment_optimizer_tpu.utils import gen
+
+N_DEV = 8  # conftest forces --xla_force_host_platform_device_count=8
+
+
+def _adv_instance(seed: int):
+    sc = gen.adversarial(n_brokers=32, n_topics_low=3, n_topics_high=3,
+                         parts_per_topic=10, seed=seed)
+    return build_instance(sc.current, sc.broker_list, sc.topology)
+
+
+@pytest.fixture
+def lane_problem():
+    """One 4-lane stacked problem (same bucket), shared per test."""
+    insts = [_adv_instance(s) for s in (7, 8, 9, 10)]
+    models = [arrays.from_instance(i) for i in insts]
+    ms = arrays.stack_models(models)
+    lane_seeds = np.stack(
+        [np.asarray(greedy_seed(i), np.int32) for i in insts]
+    )
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in range(4)])
+    temps = arrays.geometric_temps(2.0, 0.02, 8)
+    return ms, lane_seeds, keys, temps
+
+
+@pytest.fixture(autouse=True)
+def _fresh_evidence():
+    pm.reset_mesh_adapt()
+    yield
+    pm.reset_mesh_adapt()
+
+
+def _lane_solve(spec_dl, lane_problem, scorer="xla"):
+    ms, lane_seeds, keys, temps = lane_problem
+    mesh = pm.make_mesh(N_DEV, lane_devices=spec_dl)
+    state = pm.init_lane_state(ms, lane_seeds, keys, mesh, 2)
+    return pm.solve_lanes(ms, mesh, 2, temps, state=state, scorer=scorer)
+
+
+# ------------------------------------------------------------ unit layer
+
+def test_parse_mesh_sharding_grammar():
+    assert pm.parse_mesh_sharding("auto") == ("auto", None)
+    assert pm.parse_mesh_sharding("") == ("auto", None)
+    assert pm.parse_mesh_sharding("off") == ("off", None)
+    assert pm.parse_mesh_sharding("4x2") == ("spec", (4, 2))
+    assert pm.parse_mesh_sharding(" 8X1 ") == ("spec", (8, 1))
+    # typos degrade, never crash a solve
+    assert pm.parse_mesh_sharding("4by2")[0] == "invalid"
+    assert pm.parse_mesh_sharding("0x8")[0] == "invalid"
+
+
+def test_candidate_shardings_divisibility():
+    # dl must divide BOTH the device count and the lane count; the
+    # default chains-only split always leads
+    assert pm.candidate_shardings(8, 4) == [(8, 1), (4, 2), (2, 4)]
+    assert pm.candidate_shardings(8, 6) == [(8, 1), (4, 2)]
+    assert pm.candidate_shardings(8, 1) == [(8, 1)]
+    assert pm.candidate_shardings(1, 4) == [(1, 1)]
+
+
+def test_mesh_spec_roundtrip_and_validation():
+    mesh = pm.make_mesh(N_DEV, lane_devices=2)
+    assert pm.mesh_spec(mesh) == (4, 2)
+    assert mesh.axis_names == (pm.AXIS, pm.AXIS_LANES)
+    with pytest.raises(ValueError, match="does not divide"):
+        pm.make_mesh(N_DEV, lane_devices=3)
+    # default mesh is layout-identical to the historical chains split
+    assert pm.mesh_spec(pm.make_mesh(N_DEV)) == (N_DEV, 1)
+
+
+def test_choose_sharding_never_guesses(monkeypatch):
+    bkt = (32, 8, 90, 3)
+    monkeypatch.delenv(pm.MESH_ENV, raising=False)
+    # no evidence → default
+    assert pm.choose_sharding(bkt, 8, 4) == (8, 1)
+    # a qualified challenger alone is NOT enough: the default itself
+    # must have quorum before the chooser trusts the comparison
+    for _ in range(pm.MESH_MIN_SOLVES):
+        pm.note_sharding_evidence(bkt, (4, 2), lanes=4, solves=1,
+                                  device_s=0.5)
+    assert pm.choose_sharding(bkt, 8, 4) == (8, 1)
+    for _ in range(pm.MESH_MIN_SOLVES):
+        pm.note_sharding_evidence(bkt, (8, 1), lanes=4, solves=1,
+                                  device_s=1.0)
+    # both qualified, challenger 2x faster → challenger
+    assert pm.choose_sharding(bkt, 8, 4) == (4, 2)
+    # multi-controller SPMD must not fork the executable per process
+    assert pm.choose_sharding(bkt, 8, 4, multi=True) == (8, 1)
+    # env pin beats evidence; off and invalid degrade to default
+    monkeypatch.setenv(pm.MESH_ENV, "2x4")
+    assert pm.choose_sharding(bkt, 8, 4) == (2, 4)
+    monkeypatch.setenv(pm.MESH_ENV, "off")
+    assert pm.choose_sharding(bkt, 8, 4) == (8, 1)
+    monkeypatch.setenv(pm.MESH_ENV, "3x3")  # does not fit 8 devices
+    assert pm.choose_sharding(bkt, 8, 4) == (8, 1)
+
+
+def test_mesh_snapshot_shape(monkeypatch):
+    monkeypatch.delenv(pm.MESH_ENV, raising=False)
+    bkt = (32, 8, 90, 3)
+    pm.note_sharding_evidence(bkt, (4, 2), lanes=4, solves=2,
+                              device_s=1.0)
+    pm.make_mesh(N_DEV, lane_devices=2)
+    snap = pm.mesh_snapshot()
+    assert snap["axes"] == {pm.AXIS: 4, pm.AXIS_LANES: 2}
+    assert snap["sharding_mode"] == "auto"
+    assert snap["min_solves"] == pm.MESH_MIN_SOLVES
+    (bucket_row,) = snap["buckets"].values()
+    assert bucket_row["evidence"]["4x2"]["solves"] == 2
+    assert set(snap["counters"]) == {"search_evals", "reshard_bytes"}
+
+
+# ---------------------------------------------------------- parity layer
+
+def test_sharded_lane_solve_bit_parity(lane_problem):
+    """THE acceptance pin: every (dc, dl) split of an 8-device bucket
+    replays the default split's sync chunked trajectory bit-for-bit,
+    with identical global output shapes."""
+    base = _lane_solve(1, lane_problem)
+    for dl in (2, 4):
+        out = _lane_solve(dl, lane_problem)
+        for name, a, b in zip(("state", "best_a", "best_k", "curve"),
+                              base, out):
+            if name == "state":
+                for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                    assert la.shape == lb.shape
+                    assert np.array_equal(np.asarray(la), np.asarray(lb))
+                continue
+            assert np.asarray(a).shape == np.asarray(b).shape
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"{name} diverged at split {N_DEV // dl}x{dl}"
+            )
+
+
+def test_sharded_interpret_scorer_bit_parity(lane_problem):
+    """The Pallas-interpret scorer (the Mosaic code path) under a lane
+    split matches the unsharded interpret run bit-for-bit."""
+    base = _lane_solve(1, lane_problem, scorer="pallas-interpret")
+    out = _lane_solve(2, lane_problem, scorer="pallas-interpret")
+    assert np.array_equal(np.asarray(base[1]), np.asarray(out[1]))
+    assert np.array_equal(np.asarray(base[2]), np.asarray(out[2]))
+    assert np.array_equal(np.asarray(base[3]), np.asarray(out[3]))
+
+
+def test_sharded_megachunk_bit_parity(lane_problem):
+    """The fused K-chunk scan under a lane split replays the unsharded
+    megachunk dispatch bit-for-bit (certs disarmed: independent lanes
+    must not share an early exit)."""
+    ms, lane_seeds, keys, temps = lane_problem
+    temps_stack = jnp.stack([temps, temps])  # K=2 fused chunks
+    outs = []
+    for dl in (1, 2):
+        mesh = pm.make_mesh(N_DEV, lane_devices=dl)
+        state = pm.init_lane_state(ms, lane_seeds, keys, mesh, 2)
+        outs.append(pm.solve_lanes_megachunk(
+            ms, mesh, 2, temps_stack, state,
+        ))
+    for i, (a, b) in enumerate(zip(*outs)):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            assert la.shape == lb.shape
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                f"megachunk output {i} diverged under the 4x2 split"
+            )
+
+
+def test_sharded_warm_resolve_compiles_nothing(lane_problem, monkeypatch):
+    """Each split is its own AOT executable: the second solve at the
+    same (bucket, split) must reuse it — zero fresh compiles — and the
+    donation round-trip leaves the answer unchanged."""
+    ms, lane_seeds, keys, temps = lane_problem
+    compiles: list = []
+    real = pm._lower_and_compile
+
+    def counting(fn, args):
+        compiles.append(pm._arg_signature(args))
+        return real(fn, args)
+
+    monkeypatch.setattr(pm, "_lower_and_compile", counting)
+    mesh = pm.make_mesh(N_DEV, lane_devices=2)
+    state = pm.init_lane_state(ms, lane_seeds, keys, mesh, 2)
+    r1 = pm.solve_lanes(ms, mesh, 2, temps, state=state)
+    after_first = len(compiles)
+    state = pm.init_lane_state(ms, lane_seeds, keys, mesh, 2)
+    r2 = pm.solve_lanes(ms, mesh, 2, temps, state=state)
+    assert len(compiles) == after_first, (
+        f"warm sharded re-solve recompiled: {compiles[after_first:]}"
+    )
+    assert np.array_equal(np.asarray(r1[2]), np.asarray(r2[2]))
+
+
+def test_sharding_search_files_evidence(lane_problem, monkeypatch):
+    """The active search runs every candidate through the real dispatch
+    path, proves parity against the default, and lands its timings in
+    the same evidence table production solves feed."""
+    monkeypatch.delenv(pm.MESH_ENV, raising=False)
+    ms, lane_seeds, keys, temps = lane_problem
+    bkt = (32, 8, 90, 3)
+    results = pm.run_sharding_search(
+        ms, lane_seeds, keys, temps, n_devices=N_DEV,
+        chains_per_device=2, bucket_key=bkt, repeats=1,
+    )
+    assert [r["spec"] for r in results] == ["8x1", "4x2", "2x4"]
+    assert all(r["parity_vs_default"] for r in results)
+    assert all(r["warm_s"] > 0 for r in results)
+    assert pm.mesh_counters()["search_evals"] == 3
+    snap = pm.mesh_snapshot()
+    (bucket_row,) = snap["buckets"].values()
+    assert set(bucket_row["evidence"]) == {"8x1", "4x2", "2x4"}
+
+
+# ---------------------------------------------------------- engine layer
+
+def test_engine_batch_parity_under_forced_split(monkeypatch):
+    """Engine-level acceptance: ``solve_tpu_batch`` under a forced
+    ``KAO_MESH_SHARDING=4x2`` returns the byte-identical plans of the
+    default split — the env pin changes placement, never results — and
+    the dispatch filed sharding evidence for the bucket."""
+    insts = [_adv_instance(s) for s in (7, 8, 9, 10)]
+    monkeypatch.delenv(pm.MESH_ENV, raising=False)
+    base = solve_tpu_batch(insts, seeds=0, engine="sweep", batch=8,
+                           rounds=8)
+    monkeypatch.setenv(pm.MESH_ENV, "4x2")
+    sharded = solve_tpu_batch(insts, seeds=0, engine="sweep", batch=8,
+                              rounds=8)
+    for i, (rb, rs) in enumerate(zip(base, sharded)):
+        assert np.array_equal(rb.a, rs.a), f"lane {i} diverged"
+        assert rb.objective == rs.objective
+    snap = pm.mesh_snapshot()
+    specs = {s for row in snap["buckets"].values()
+             for s in row["evidence"]}
+    assert "4x2" in specs
+
+
+def test_mesh_counters_reset_semantics():
+    """reset_mesh_adapt drops BOTH the evidence table and the running
+    counters — a maintenance reset can never leave a stale choice
+    backed by zeroed evidence."""
+    bkt = (32, 8, 90, 3)
+    pm.note_sharding_evidence(bkt, (4, 2), lanes=4, solves=2,
+                              device_s=1.0)
+    with pm._MESH_LOCK:
+        pm._MESH_COUNTERS["search_evals"] += 3
+    assert pm.mesh_counters()["search_evals"] == 3
+    assert pm.mesh_snapshot()["buckets"]
+    pm.reset_mesh_adapt()
+    assert pm.mesh_counters() == {"search_evals": 0, "reshard_bytes": 0}
+    assert pm.mesh_snapshot()["buckets"] == {}
+
+
+def test_make_solve_mesh_gating(monkeypatch):
+    """The engine-facing factory only ever lane-splits a multi-lane
+    sweep dispatch; chain engines, single-lane sites, and 1-device
+    runs always get the historical chains-only mesh."""
+    monkeypatch.delenv(pm.MESH_ENV, raising=False)
+    bkt = (32, 8, 90, 3)
+    assert pm.mesh_spec(pm.make_solve_mesh(N_DEV)) == (N_DEV, 1)
+    assert pm.mesh_spec(
+        pm.make_solve_mesh(N_DEV, lanes=4, engine="chain")
+    ) == (N_DEV, 1)
+    assert pm.mesh_spec(pm.make_solve_mesh(1, lanes=4)) == (1, 1)
+    # with qualified evidence on both sides, the sweep dispatch follows
+    # the per-bucket winner
+    for _ in range(pm.MESH_MIN_SOLVES):
+        pm.note_sharding_evidence(bkt, (8, 1), lanes=4, solves=1,
+                                  device_s=1.0)
+        pm.note_sharding_evidence(bkt, (4, 2), lanes=4, solves=1,
+                                  device_s=0.5)
+    assert pm.mesh_spec(
+        pm.make_solve_mesh(N_DEV, lanes=4, bucket_key=bkt)
+    ) == (4, 2)
+    # multi-controller SPMD must not fork the executable per process
+    assert pm.mesh_spec(
+        pm.make_solve_mesh(N_DEV, lanes=4, bucket_key=bkt, multi=True)
+    ) == (N_DEV, 1)

@@ -802,3 +802,56 @@ def test_exemplar_chain_metrics_to_chrome_trace(obs_server):
     # the record STREAM (ring + SLO + histograms) saw the solve
     assert hz["observability"]["flight"]["stream_records_total"] >= 1
     assert hz["observability"]["flight"]["enabled"] == 0
+
+
+# --------------------------------------------------------------------------
+# sharded-mesh comparator keys (ISSUE 19, docs/MESH.md)
+# --------------------------------------------------------------------------
+
+
+def test_regress_mesh_bench_keys():
+    """The --mesh-bench block participates in the gate: best-split
+    lanes/s as throughput (quorum honesty: a single-core box's flat
+    curve must not read as regression by itself) and parity_ok as a
+    deterministic quality trip."""
+    art = _artifact()
+    art["mesh_bench"] = {"parity_ok": True, "best_spec": "8x1",
+                         "best_lanes_per_s": 5.0, "lane_scaling": 1.0}
+    thr = [n for n, _, _ in oregress._throughput_pairs(art, art)]
+    assert "mesh_bench.best_lanes_per_s" in thr
+    # a parity flip is a confirmed quality regression — the soak A/B
+    # self-compare turns a sharding bit-parity break into exit 3
+    bad = json.loads(json.dumps(art))
+    bad["mesh_bench"]["parity_ok"] = False
+    v = oregress.compare(art, bad)
+    assert v["verdict"] == "regression"
+    assert any(r["metric"] == "mesh_bench.parity_ok"
+               for r in v["quality_regressions"])
+    # artifacts without the block stay comparable (the key set is
+    # presence-gated, like every other block)
+    v2 = oregress.compare(_artifact(), _artifact())
+    assert v2["comparable"] and v2["verdict"] == "ok"
+
+
+def test_regress_refuses_topology_mismatch():
+    """Process/mesh topology is an env-stamp comparability axis: a
+    1-process artifact never silently diffs against a 2-process one,
+    and a different chains×lanes split is likewise incomparable —
+    but artifacts predating the stamp (no topology keys) still
+    compare."""
+    art = _artifact()
+    art["env"]["n_processes"] = 1
+    art["env"]["mesh_axes"] = {"chains": 8, "lanes": 1}
+    other = json.loads(json.dumps(art))
+    other["env"]["n_processes"] = 2
+    v = oregress.compare(art, other)
+    assert v["verdict"] == "incomparable" and not v["comparable"]
+    split = json.loads(json.dumps(art))
+    split["env"]["mesh_axes"] = {"chains": 4, "lanes": 2}
+    assert oregress.compare(art, split)["verdict"] == "incomparable"
+    # --force overrides, as with every other env mismatch
+    assert oregress.compare(art, split, force=True)["comparable"]
+    # a pre-stamp artifact (no topology keys) is not punished
+    legacy = json.loads(json.dumps(art))
+    del legacy["env"]["n_processes"], legacy["env"]["mesh_axes"]
+    assert oregress.compare(art, legacy)["comparable"]

@@ -174,6 +174,10 @@ def test_adaptive_table_demotes_never_winners(monkeypatch):
 # -------------------------------------------------- engine + early exit
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~23 s; nightly. Tier-1 keeps the forced-midladder
+# early-exit and disabled-lane portfolio pins; the messy[1] close also
+# re-proves nightly via the soak fuzz tier.
 def test_engine_portfolio_stats_and_quality():
     """The engine-level dispatcher: portfolio provenance lands in
     stats, and at equal budget the portfolio closes the messy exact-band
@@ -330,6 +334,10 @@ def test_compound_low_temp_declines_penalized_pairs():
     assert (score2 >= score0).all(), (score0, score2)
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~19 s; nightly. Tier-1 keeps the sweep-level
+# kernel parity (test_sweep_solver_pallas_scorer_bit_identical) and
+# the sharded interpret parity (test_mesh_sharding.py).
 def test_compound_schedule_xla_vs_pallas_interpret_bit_parity():
     """The full sweep schedule — site, exchange, and compound sweeps —
     through both scorer bundles yields byte-identical winners: the

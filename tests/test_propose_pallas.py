@@ -64,6 +64,9 @@ def test_proposals_bit_identical(rng, temp):
 
 
 @pytest.mark.soak
+@pytest.mark.slow  # ~20 s; nightly. Tier-1 keeps kernel-vs-XLA parity
+# via test_sweep_solver_pallas_scorer_bit_identical and the
+# exchange-counts pin below.
 def test_sweep_trajectory_bit_identical_with_kernel(rng):
     """Full sweeps through thin_apply: the applied population must be
     byte-equal between the XLA and kernel proposal paths."""
@@ -109,6 +112,8 @@ def test_unequal_racks_and_rf1_partitions(rng):
 
 
 @pytest.mark.soak
+@pytest.mark.slow  # ~14 s; nightly. Tier-1 keeps the exchange
+# count-preservation pin and the unequal-racks/rf1 shape pin.
 def test_exchange_halves_bit_identical(rng):
     """The exchange-halves kernel reproduces the XLA reference exactly,
     and the full exchange sweep is byte-equal between paths."""

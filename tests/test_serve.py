@@ -429,6 +429,10 @@ def test_warmup_endpoint_precompiles_bucket(server_url):
         assert status == 400, (bad, body)
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~21 s (a real /warmup decompose compile); nightly.
+# Tier-1 keeps the warmup-endpoint compile pin and the /healthz
+# malformed-body 400s.
 def test_healthz_decompose_section_and_warmup(server_url):
     """PR 16 satellite: /healthz carries the decompose config/counters
     and /warmup {"decompose": true} precompiles the map-lane shape."""

@@ -94,6 +94,10 @@ def _run_worker(cache_dir: str) -> dict:
     return json.loads(line[len("STATS "):])
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~15 s (spawns a second python); nightly — the
+# fleet-warmup soak step proves the same 0-fresh-compile contract
+# across REAL worker processes every night.
 def test_second_process_pays_zero_fresh_compiles(tmp_path):
     """The satellite's acceptance proof: worker 1 cold-compiles the
     demo bucket into the shared dir; worker 2 — a genuinely fresh

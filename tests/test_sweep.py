@@ -188,6 +188,10 @@ def test_sweep_migration_propagates_global_best(rng):
     assert (bk >= good_key).all(), bk
 
 
+@pytest.mark.soak
+@pytest.mark.slow  # ~18 s; nightly. Tier-1 keeps the incremental-
+# delta tracking pin (test_incremental_deltas_track_full_score) and
+# the golden sweep trajectory.
 def test_delta_stepper_bit_identical_to_from_scratch(rng):
     """The r5 delta engine (carried histograms updated from the kept
     moves) must replay the from-scratch formulation EXACTLY: same keys
@@ -317,6 +321,10 @@ def test_sweep_solver_pallas_scorer_bit_identical(rng):
 
 
 @pytest.mark.soak
+@pytest.mark.slow  # ~15 s; nightly. The kernel-inside-shard_map vma
+# regression is also exercised tier-1 by the sharded interpret parity
+# pin (test_mesh_sharding.py), which dispatches the same bundle under
+# shard_map on every run.
 def test_sweep_pallas_scorer_inside_shard_map(rng):
     """Regression for the r2 TPU bench crash: pallas_call's plain
     ShapeDtypeStruct out_shapes have no vma annotation, which
