@@ -46,15 +46,34 @@ def iter_py_files(root: str):
 
 def lint_paths(paths=None, rules=None) -> list[Finding]:
     """Run the AST pass over ``paths`` (default: the installed package
-    tree). ``rules`` optionally restricts to a set of KAO IDs."""
+    tree). ``rules`` optionally restricts to a set of KAO IDs.
+
+    Lock-order edges (KAO118) are additionally stitched ACROSS files
+    here: per-file analysis sees each module's acquisition graph, but
+    an inversion split between two modules only closes into a cycle on
+    the union graph."""
+    from .concurrency import cycle_findings, file_concurrency
+    from .findings import parse_suppressions
+
     root = package_root()
     findings: list[Finding] = []
+    edges = []
+    texts: dict[str, str] = {}
     for p in paths or [root]:
         for path in iter_py_files(p):
             rel = os.path.relpath(path, root).replace(os.sep, "/")
             with open(path, encoding="utf-8") as f:
                 text = f.read()
+            texts[path] = text
             findings.extend(lint_source(text, path, rel=rel))
+            edges.extend(file_concurrency(text, path, rel).edges)
+    seen = {(f.rule, f.path, f.line) for f in findings}
+    for f in cycle_findings(edges):
+        if (f.rule, f.path, f.line) in seen:
+            continue  # intra-file copy already reported by lint_source
+        sup = parse_suppressions(texts.get(f.path, ""))
+        if not sup.active(f.rule, f.line):
+            findings.append(f)
     if rules:
         findings = [f for f in findings if f.rule in rules]
     return findings

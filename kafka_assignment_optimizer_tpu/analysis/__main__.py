@@ -41,7 +41,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="run only the jaxpr contract pass",
     )
     ap.add_argument(
-        "--format", choices=["text", "json"], default="text",
+        "--format", choices=["text", "json", "sarif"], default="text",
+    )
+    ap.add_argument(
+        "--baseline", metavar="FILE",
+        help="findings-ratchet baseline (analysis_baseline.json): "
+        "baselined findings are tolerated, NEW findings fail, and "
+        "fixed-but-not-removed baseline entries also fail",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite --baseline FILE from the current findings "
+        "(the only sanctioned way to shrink or refresh it)",
     )
     ap.add_argument(
         "--list-rules", action="store_true",
@@ -66,6 +77,8 @@ def main(argv=None) -> int:
             "--contracts-only does not take paths (contracts always "
             "run against the installed package)"
         )
+    if args.update_baseline and not args.baseline:
+        build_parser().error("--update-baseline requires --baseline")
     if args.rule:
         unknown = sorted(set(args.rule) - set(RULES))
         if unknown:
@@ -92,21 +105,59 @@ def main(argv=None) -> int:
 
         rep = run_contracts()
         findings += rep.findings
+
+    from . import baseline as _baseline
+
+    if args.update_baseline:
+        _baseline.save(args.baseline, findings)
+        # kao: disable=KAO106 -- kao-check's own stdout IS the product
+        print(f"kao-check: baseline rewritten with {len(findings)} "
+              f"finding(s): {args.baseline}")
+        return 0
+
+    ratchet = None
+    if args.baseline:
+        try:
+            entries = _baseline.load(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            build_parser().error(f"--baseline: {exc}")
+        ratchet = _baseline.compare(findings, entries)
+
     if args.format == "json":
         # kao: disable=KAO106 -- kao-check's own stdout IS the product
         print(json.dumps(
             [f.__dict__ for f in findings], indent=2
         ))
+    elif args.format == "sarif":
+        from . import sarif as _sarif
+
+        known = (set() if ratchet is None else
+                 {i for i, f in enumerate(findings)
+                  if f in ratchet.known})
+        # kao: disable=KAO106 -- kao-check's own stdout IS the product
+        print(json.dumps(_sarif.render(findings, known), indent=2))
     else:
-        for f in findings:
+        fail_set = findings if ratchet is None else ratchet.new
+        for f in fail_set:
             # kao: disable=KAO106 -- kao-check's own stdout IS the product
             print(f.render())
+        if ratchet is not None:
+            for e in ratchet.stale:
+                # kao: disable=KAO106 -- kao-check's own stdout IS the product
+                print(f"{e['path']}: stale baseline entry for "
+                      f"{e['rule']} ({e['message']!r}) — the finding "
+                      "is fixed; run --update-baseline to drop it")
         root = args.paths or [package_root()]
+        tail = ("" if ratchet is None else
+                f" ({len(ratchet.known)} baselined, "
+                f"{len(ratchet.stale)} stale)")
         # kao: disable=KAO106 -- kao-check's own stdout IS the product
         print(
-            f"kao-check: {len(findings)} finding(s) in "
-            f"{', '.join(root)}"
+            f"kao-check: {len(fail_set)} "
+            f"finding(s) in {', '.join(root)}{tail}"
         )
+    if ratchet is not None:
+        return 0 if ratchet.clean else 1
     return 1 if findings else 0
 
 

@@ -43,6 +43,14 @@ RULES: dict[str, str] = {
               "megachunk)",
     "KAO114": "wall-clock delta outside the accounting funnel in a "
               "dispatch hot module",
+    "KAO115": "implicit sharding or stale device snapshot in a mesh "
+              "hot module",
+    "KAO116": "guarded attribute mutated outside its lock",
+    "KAO117": "blocking call while holding a lock",
+    "KAO118": "lock-acquisition-order cycle (static deadlock "
+              "candidate)",
+    "KAO119": "thread started without join/daemon/lifecycle "
+              "registration in a serving-plane module",
     "KAO201": "jaxpr contract violation (solver trace)",
     "KAO202": "donation aliasing contract violation",
 }

@@ -218,8 +218,27 @@ def lint_source(
     out += _rule_scan_host_sync(tree, path)
     out += _rule_time_delta(tree, path, rel)
     out += _rule_mesh_sharding(tree, path, rel)
+    out += _concurrency_findings(tree, text, path, rel)
+    return apply_file_suppressions(out, path, text)
+
+
+def _concurrency_findings(tree, text, path, rel) -> list[Finding]:
+    # local import: concurrency imports Finding from .findings only,
+    # but keep the layering acyclic and lazy
+    from .concurrency import analyze_module
+    return analyze_module(tree, text, path, rel).findings
+
+
+def apply_file_suppressions(
+    findings: list[Finding], path: str, text: str
+) -> list[Finding]:
+    """THE suppression gate: every rule — AST, concurrency, contracts,
+    cross-file — funnels its findings through here so ``# kao:
+    disable=KAOxxx -- reason`` behaves identically everywhere and a
+    reason-less disable surfaces as KAO100 exactly once per line."""
     sup = parse_suppressions(text)
-    return apply_suppressions(sorted(out, key=lambda f: f.line), path, sup)
+    return apply_suppressions(
+        sorted(findings, key=lambda f: (f.line, f.rule)), path, sup)
 
 
 # ---------------------------------------------------------------- KAO106

@@ -92,7 +92,7 @@ class FleetTracker:
                  timeout_s: float = DEFAULT_TIMEOUT_S,
                  fail_after: int = DEFAULT_FAIL_AFTER,
                  fetch=None):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # kao: guards(_workers, polls_total, poll_errors_total, _thread)
         self._workers = {u.rstrip("/"): WorkerState(u)
                          for u in urls}
         self.interval_s = float(interval_s)
@@ -210,9 +210,6 @@ class FleetTracker:
             self.note_result(url, ok=True)
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-
         def run():
             while not self._stop.wait(self.interval_s):
                 try:
@@ -220,11 +217,20 @@ class FleetTracker:
                 except Exception:  # pragma: no cover - belt only
                     pass
 
+        # check-and-reserve UNDER the lock (KAO116): two racing
+        # start() calls both saw None here and spawned two pollers —
+        # double poll traffic and double-counted polls_total forever
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = thread = threading.Thread(
+                target=run, daemon=True, name="kao-router-health",
+            )
+        # prime OUTSIDE the lock: the synchronous first poll is an
+        # HTTP round-trip per worker and must not convoy the routing
+        # reads (KAO117's blocking-under-lock class)
         self.poll_once()  # prime synchronously so boot routes warm
-        self._thread = threading.Thread(
-            target=run, daemon=True, name="kao-router-health",
-        )
-        self._thread.start()
+        thread.start()
 
     def stop(self) -> None:
         self._stop.set()

@@ -2047,21 +2047,35 @@ def _watch_solve_fn(state, prev_plan, budget) -> tuple[dict, dict]:
     )
 
 
+_WATCH_CONFIG_LOCK = threading.Lock()  # kao: guards(WATCH)
+
+
 def _watch_registry() -> _wmanager.WatchRegistry:
     """The process's one watch registry, built lazily from WATCH (so
-    main() and tests configure before first touch)."""
+    main() and tests configure before first touch).
+
+    Double-checked under ``_WATCH_CONFIG_LOCK`` (KAO116): this is
+    called from concurrent HTTP handler threads (events, rollouts,
+    /debug/watch), and the unlocked check-then-act let two first-touch
+    requests each build a registry — the loser's clusters simply
+    vanished from the winner's view."""
     reg = WATCH.get("registry")
-    if reg is None:
-        store = (
-            _wstore.PlanStore(WATCH["dir"]) if WATCH["dir"] else None
-        )
-        reg = _wmanager.WatchRegistry(
-            _watch_solve_fn, store,
-            window_s=WATCH["window_s"],
-            max_backlog=WATCH["max_backlog"],
-            solve_budget_s=WATCH["max_solve_s"],
-        )
-        WATCH["registry"] = reg
+    if reg is not None:
+        return reg
+    with _WATCH_CONFIG_LOCK:
+        reg = WATCH.get("registry")
+        if reg is None:
+            store = (
+                _wstore.PlanStore(WATCH["dir"]) if WATCH["dir"]
+                else None
+            )
+            reg = _wmanager.WatchRegistry(
+                _watch_solve_fn, store,
+                window_s=WATCH["window_s"],
+                max_backlog=WATCH["max_backlog"],
+                solve_budget_s=WATCH["max_solve_s"],
+            )
+            WATCH["registry"] = reg
     return reg
 
 
@@ -2079,8 +2093,9 @@ def handle_cluster_event(
     malformed events (400), stale/replayed epochs (409, provably
     without a solve), impossible states (422), and storm backpressure
     (503 ``event_storm`` with Retry-After from the coalescing window)."""
-    WATCH["lock_wait_s"] = lock_wait_s
-    WATCH["max_solve_s"] = max_solve_s
+    with _WATCH_CONFIG_LOCK:
+        WATCH["lock_wait_s"] = lock_wait_s
+        WATCH["max_solve_s"] = max_solve_s
     reg = _watch_registry()
     try:
         out = reg.handle_event(cluster_id, payload)

@@ -5,6 +5,18 @@ cluster' strategy (SURVEY.md §4.5)."""
 
 import os
 
+# KAO_LSAN=1 arms the runtime lock sanitizer BEFORE any project module
+# creates its locks (module-level Lock() sites bind at import), so the
+# whole tier-1 suite doubles as a lock-order/hold-budget sanitizer run
+# (docs/ANALYSIS.md "Runtime lock sanitizer").
+_LSAN = None
+if os.environ.get("KAO_LSAN", "").strip().lower() in (
+    "1", "true", "yes", "on"
+):
+    from kafka_assignment_optimizer_tpu.analysis import lsan as _LSAN
+
+    _LSAN.install()
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -43,3 +55,19 @@ def demo():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The sanitizer gate: an armed KAO_LSAN run that recorded any
+    violation fails the session even when every test passed (the
+    violation may have happened on a daemon thread no test asserts
+    on). Deliberate-trip tests record into ``lsan.scope()`` ledgers,
+    which never land here."""
+    if _LSAN is None:
+        return
+    viol = _LSAN.violations()
+    if viol and exitstatus == 0:
+        lines = "\n".join(f"  {v.kind}: {v.detail}" for v in viol[:20])
+        print(f"\nKAO_LSAN: {len(viol)} lock-sanitizer violation(s):"
+              f"\n{lines}")  # kao: disable=KAO106 -- pytest gate output
+        session.exitstatus = 1

@@ -24,6 +24,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kafka_assignment_optimizer_tpu import build_instance
 from kafka_assignment_optimizer_tpu.parallel import mesh as pm
